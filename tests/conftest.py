@@ -1,0 +1,24 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh (the in-process "multi-node"
+strategy of the reference test suite — two Servers on loopback — maps here
+to N XLA host devices; see SURVEY.md §4). The real TPU chip is reserved for
+bench.py.
+
+The driver image's sitecustomize registers the tunneled TPU ("axon") PJRT
+plugin at interpreter boot and force-sets jax_platforms="axon,cpu",
+overriding the JAX_PLATFORMS env var — so env vars alone can't keep tests
+off the tunnel. Re-set the config here, before any backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,105 @@
+"""Pure-Python single-digest oracle mirroring the Go merging t-digest.
+
+This is a test fixture, not product code: a faithful reimplementation of the
+*algorithm* of tdigest/merging_digest.go (sym: MergingDigest.Add,
+.mergeAllTemps, .Quantile) used as the parity oracle for the batched TPU
+kernels — the role the Go reference's own test properties play in
+tdigest/merging_digest_test.go.
+"""
+
+import math
+
+
+class OracleDigest:
+    def __init__(self, compression=100.0, buf_size=256):
+        self.compression = compression
+        self.buf_size = buf_size
+        self.means = []    # merged centroid means, sorted
+        self.weights = []
+        self.buf = []      # (value, weight) pending
+        self.min = math.inf
+        self.max = -math.inf
+        self.sum = 0.0
+        self.count = 0.0
+
+    def _k1(self, q):
+        q = min(max(q, 0.0), 1.0)
+        return self.compression * (
+            math.asin(2.0 * q - 1.0) + math.pi / 2.0) / math.pi
+
+    def add(self, value, weight=1.0):
+        self.buf.append((value, weight))
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        self.sum += value * weight
+        self.count += weight
+        if len(self.buf) >= self.buf_size:
+            self.compress()
+
+    def merge(self, other):
+        other.compress()
+        for m, w in zip(other.means, other.weights):
+            self.buf.append((m, w))
+            if len(self.buf) >= self.buf_size:
+                self.compress()
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        self.sum += other.sum
+        self.count += other.count
+
+    def compress(self):
+        items = sorted(
+            list(zip(self.means, self.weights)) + self.buf,
+            key=lambda t: t[0])
+        self.buf = []
+        if not items:
+            return
+        total = sum(w for _, w in items)
+        means, weights = [], []
+        k_start = None
+        cum = 0.0
+        cur_wv = 0.0
+        cur_w = 0.0
+        for v, w in items:
+            if w <= 0:
+                continue
+            k_left = self._k1(cum / total)
+            k_right = self._k1((cum + w) / total)
+            if k_start is None or k_right - k_start > 1.0:
+                if cur_w > 0:
+                    means.append(cur_wv / cur_w)
+                    weights.append(cur_w)
+                k_start = k_left
+                cur_wv, cur_w = 0.0, 0.0
+            cur_wv += v * w
+            cur_w += w
+            cum += w
+        if cur_w > 0:
+            means.append(cur_wv / cur_w)
+            weights.append(cur_w)
+        self.means, self.weights = means, weights
+
+    def quantile(self, q):
+        self.compress()
+        if not self.means:
+            return 0.0
+        total = sum(self.weights)
+        # knots: (0, min), ((cum - w/2)/W, mean_i)..., (1, max)
+        xs = [0.0]
+        ys = [self.min]
+        cum = 0.0
+        for m, w in zip(self.means, self.weights):
+            xs.append((cum + w / 2.0) / total)
+            ys.append(m)
+            cum += w
+        xs.append(1.0)
+        ys.append(self.max)
+        if q <= xs[0]:
+            return ys[0]
+        for i in range(1, len(xs)):
+            if q <= xs[i]:
+                if xs[i] == xs[i - 1]:
+                    return ys[i]
+                t = (q - xs[i - 1]) / (xs[i] - xs[i - 1])
+                return ys[i - 1] + t * (ys[i] - ys[i - 1])
+        return ys[-1]

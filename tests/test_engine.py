@@ -1,0 +1,196 @@
+"""End-to-end engine tests: parse -> process -> flush -> InterMetrics,
+plus the in-process two-tier (local Servers -> global Server) merge test —
+the reference's "multi-node without a cluster" strategy (server_test.go,
+flusher_test.go)."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.ingest import parser
+from veneur_tpu.metrics import MetricType
+from veneur_tpu.models.pipeline import AggregationEngine, EngineConfig
+
+
+def small_config(**kw):
+    defaults = dict(histogram_slots=256, counter_slots=128, gauge_slots=128,
+                    set_slots=64, batch_size=512, buffer_depth=128)
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+def feed(engine, lines):
+    for line in lines:
+        m = parser.parse_packet(line)
+        engine.process(m)
+
+
+def by_name(metrics):
+    return {m.name: m for m in metrics}
+
+
+def test_local_flush_all_types():
+    eng = AggregationEngine(small_config())
+    lines = [b"c.hits:3|c", b"c.hits:2|c|@0.5", b"g.temp:70|g",
+             b"g.temp:71.5|g", b"s.users:alice|s", b"s.users:bob|s",
+             b"s.users:alice|s"]
+    lines += [f"t.req:{v}|ms".encode() for v in range(1, 101)]
+    feed(eng, lines)
+    res = eng.flush(timestamp=1000)
+    m = by_name(res.metrics)
+
+    assert m["c.hits"].value == pytest.approx(3 + 2 * 2)  # rate-corrected
+    assert m["c.hits"].type == MetricType.COUNTER
+    assert m["g.temp"].value == 71.5
+    assert m["s.users"].value == pytest.approx(2, abs=0.5)  # 2 uniques
+    assert m["t.req.min"].value == 1.0
+    assert m["t.req.max"].value == 100.0
+    assert m["t.req.count"].value == 100.0
+    assert m["t.req.50percentile"].value == pytest.approx(50.5, rel=0.05)
+    assert m["t.req.99percentile"].value == pytest.approx(99.5, rel=0.05)
+    assert m["t.req.min"].timestamp == 1000
+    assert not res.export.histograms  # no forwarding configured
+
+
+def test_tags_preserved_and_keys_distinct():
+    eng = AggregationEngine(small_config())
+    feed(eng, [b"api.reqs:1|c|#route:a", b"api.reqs:1|c|#route:b",
+               b"api.reqs:1|c|#route:a"])
+    res = eng.flush(timestamp=5)
+    vals = {tuple(m.tags): m.value for m in res.metrics}
+    assert vals[("route:a",)] == 2.0
+    assert vals[("route:b",)] == 1.0
+
+
+def test_interval_reset():
+    eng = AggregationEngine(small_config())
+    feed(eng, [b"x:5|c"])
+    r1 = eng.flush(timestamp=1)
+    assert by_name(r1.metrics)["x"].value == 5.0
+    r2 = eng.flush(timestamp=2)  # x not sampled again -> not re-reported
+    assert "x" not in by_name(r2.metrics)
+    feed(eng, [b"x:7|c"])
+    r3 = eng.flush(timestamp=3)
+    assert by_name(r3.metrics)["x"].value == 7.0  # not 12: state reset
+
+
+def test_scope_routing_with_forwarding():
+    eng = AggregationEngine(small_config(
+        forward_enabled=True, aggregates=("min", "max", "count")))
+    feed(eng, [b"t.mixed:10|ms", b"t.mixed:20|ms",
+               b"t.local:5|ms|#veneurlocalonly",
+               b"t.global:9|ms|#veneurglobalonly",
+               b"c.local:1|c",
+               b"c.global:4|c|#veneurglobalonly",
+               b"s.mixed:a|s"])
+    res = eng.flush(timestamp=10)
+    m = by_name(res.metrics)
+
+    # mixed histo: local aggregates, no local percentiles; digest forwarded
+    assert "t.mixed.min" in m and "t.mixed.max" in m
+    assert "t.mixed.50percentile" not in m
+    fwd_names = [k.name for k, *_ in res.export.histograms]
+    assert "t.mixed" in fwd_names and "t.global" in fwd_names
+    assert "t.local" not in fwd_names
+    # local-only histo flushes percentiles locally
+    assert "t.local.50percentile" in m
+    # global-only histo emits nothing locally
+    assert not any(n.startswith("t.global") for n in m)
+    # counters: local stays, global-only exported
+    assert m["c.local"].value == 1.0
+    assert "c.global" not in m
+    assert res.export.counters[0][0].name == "c.global"
+    # mixed set: sketch forwarded, no local estimate
+    assert "s.mixed" not in m
+    assert len(res.export.sets) == 1
+
+
+def test_two_tier_global_percentiles():
+    """32 local engines each see a shard of samples; the global engine must
+    report percentiles over the union within 1% (BASELINE config 4)."""
+    rng = np.random.default_rng(0)
+    data = rng.normal(100, 15, 32_000).astype(np.float32)
+    shards = np.array_split(data, 32)
+
+    glob = AggregationEngine(small_config(
+        is_global=True, percentiles=(0.5, 0.99),
+        aggregates=("min", "max", "count")))
+
+    for sh in shards:
+        local = AggregationEngine(small_config(forward_enabled=True))
+        for v in sh:
+            local.process(parser.parse_metric(b"api.lat:%f|ms" % v))
+        res = local.flush(timestamp=50)
+        assert len(res.export.histograms) == 1
+        for key, means, weights, vmin, vmax, vsum, cnt, recip in (
+                res.export.histograms):
+            glob.import_histogram(key, means, weights, vmin, vmax, vsum,
+                                  cnt, recip)
+
+    out = by_name(glob.flush(timestamp=60).metrics)
+    assert out["api.lat.count"].value == pytest.approx(len(data))
+    assert out["api.lat.min"].value == pytest.approx(data.min())
+    assert out["api.lat.max"].value == pytest.approx(data.max())
+    exact50, exact99 = np.quantile(data, [0.5, 0.99])
+    spread = data.max() - data.min()
+    assert abs(out["api.lat.50percentile"].value - exact50) < 0.01 * spread
+    assert abs(out["api.lat.99percentile"].value - exact99) < 0.01 * spread
+
+
+def test_two_tier_sets_and_counters():
+    glob = AggregationEngine(small_config(is_global=True))
+    total_members = set()
+    for shard in range(4):
+        local = AggregationEngine(small_config(forward_enabled=True))
+        for i in range(2000):
+            member = f"u{shard % 2}-{i}"  # shards 0/2 and 1/3 overlap
+            total_members.add(member)
+            local.process(parser.parse_metric(
+                b"users:%s|s" % member.encode()))
+            local.process(parser.parse_metric(
+                b"reqs:1|c|#veneurglobalonly"))
+        res = local.flush(timestamp=1)
+        for key, regs in res.export.sets:
+            glob.import_set(key, regs)
+        for key, val in res.export.counters:
+            glob.import_counter(key, val)
+    out = by_name(glob.flush(timestamp=2).metrics)
+    assert out["reqs"].value == pytest.approx(8000)
+    assert out["users"].value == pytest.approx(len(total_members), rel=0.03)
+
+
+def test_percentile_names_and_median():
+    eng = AggregationEngine(small_config(
+        percentiles=(0.99, 0.999, 0.29),
+        aggregates=("median", "count")))
+    feed(eng, [b"t:%d|ms" % v for v in range(1, 1001)])
+    m = by_name(eng.flush(timestamp=1).metrics)
+    assert "t.99percentile" in m and "t.99.9percentile" in m
+    assert "t.29percentile" in m  # not truncated to 28
+    assert m["t.median"].value == pytest.approx(500.5, rel=0.02)
+    assert m["t.count"].value == 1000.0
+
+
+def test_events_and_checks_drain():
+    eng = AggregationEngine(small_config())
+    eng.process_event(parser.parse_packet(b"_e{2,2}:ab|cd"))
+    eng.process_service_check(parser.parse_packet(b"_sc|svc|0"))
+    evs, chks = eng.drain_events()
+    assert len(evs) == 1 and len(chks) == 1
+    assert eng.drain_events() == ([], [])
+
+
+def test_slot_eviction_and_reuse():
+    eng = AggregationEngine(small_config(
+        counter_slots=4, idle_ttl_intervals=2))
+    for i in range(4):
+        feed(eng, [b"c%d:1|c" % i])
+    eng.flush(timestamp=1)
+    assert len(eng.counter_keys) == 4
+    # new keys don't fit until eviction kicks in
+    feed(eng, [b"c.new:1|c"])
+    assert eng.counter_keys.dropped_no_slot == 1
+    eng.flush(timestamp=2)
+    eng.flush(timestamp=3)  # idle for > ttl -> evicted
+    feed(eng, [b"c.new2:1|c"])
+    res = eng.flush(timestamp=4)
+    assert by_name(res.metrics)["c.new2"].value == 1.0

@@ -1,0 +1,129 @@
+"""Tests for the HLL, counter and gauge banks (samplers.Set/Counter/Gauge
+semantics — sample-rate weighting, last-write-wins, Export->Combine
+roundtrip equivalence, mirroring samplers/samplers_test.go's strategy)."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.ops import hll, scalar
+from veneur_tpu.utils import hashing
+
+
+def _insert_members(bank, slot, members, precision=14):
+    hashes = np.array([hashing.set_member_hash(m) for m in members],
+                      np.uint64)
+    idx, rho = hll.host_hash_to_updates(hashes, precision)
+    slots = np.full(len(members), slot, np.int32)
+    return hll.insert(bank, slots, idx, rho)
+
+
+def test_hll_estimate_accuracy():
+    bank = hll.init(4)
+    n = 100_000
+    members = [f"user-{i}" for i in range(n)]
+    bank = _insert_members(bank, 2, members)
+    est = np.asarray(hll.estimate(bank))
+    assert est[0] == 0.0
+    # p=14 standard error ~0.81%; allow 3 sigma.
+    assert abs(est[2] - n) / n < 0.025
+
+
+def test_hll_duplicates_dont_count():
+    bank = hll.init(2)
+    members = [f"x-{i % 50}" for i in range(5000)]
+    bank = _insert_members(bank, 0, members)
+    est = np.asarray(hll.estimate(bank))[0]
+    assert abs(est - 50) < 3
+
+
+def test_hll_small_cardinality():
+    bank = hll.init(1)
+    bank = _insert_members(bank, 0, ["a", "b", "c"])
+    est = np.asarray(hll.estimate(bank))[0]
+    assert abs(est - 3) < 0.5
+
+
+def test_hll_merge_equals_union():
+    """Export->Combine roundtrip: merging two sketches == one sketch over
+    the union (BASELINE config 3: 1M uniques over sharded sets)."""
+    a = hll.init(1)
+    b = hll.init(1)
+    u = hll.init(1)
+    ma = [f"a-{i}" for i in range(40_000)]
+    mb = [f"b-{i}" for i in range(40_000)] + ma[:10_000]
+    a = _insert_members(a, 0, ma)
+    b = _insert_members(b, 0, mb)
+    u = _insert_members(u, 0, ma + mb)
+    merged = hll.merge_banks(a, b)
+    est_m = np.asarray(hll.estimate(merged))[0]
+    est_u = np.asarray(hll.estimate(u))[0]
+    assert est_m == pytest.approx(est_u)  # register-exact same sketch
+    assert abs(est_m - 80_000) / 80_000 < 0.025
+
+
+def test_hll_merge_rows_combine():
+    a = hll.init(2)
+    local = hll.init(1)
+    local = _insert_members(local, 0, [f"m-{i}" for i in range(1000)])
+    regs = np.asarray(local.registers)
+    a = hll.merge_rows(a, np.array([1], np.int32), regs)
+    est = np.asarray(hll.estimate(a))
+    assert est[0] == 0.0
+    assert abs(est[1] - 1000) / 1000 < 0.03
+
+
+def test_counter_rate_weighting_and_precision():
+    bank = scalar.init_counters(3)
+    # 1/rate weighting: 100 samples at rate 0.1 == 1000
+    slots = np.full(100, 1, np.int32)
+    vals = np.ones(100, np.float32)
+    wts = np.full(100, 10.0, np.float32)
+    bank = scalar.counter_add(bank, slots, vals, wts)
+    hi, lo = scalar.counter_totals(bank)
+    total = np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+    assert total[1] == pytest.approx(1000.0)
+
+    # f32-overflow regression: 20M increments of 1 in 2k batches must not
+    # lose integer exactness (plain f32 stalls at 2^24).
+    bank = scalar.init_counters(1)
+    slots = np.zeros(10_000, np.int32)
+    ones = np.ones(10_000, np.float32)
+    for _ in range(2000):
+        bank = scalar.counter_add(bank, slots, ones, ones)
+    hi, lo = scalar.counter_totals(bank)
+    total = float(np.asarray(hi, np.float64)[0]) + float(
+        np.asarray(lo, np.float64)[0])
+    assert total == 20_000_000.0
+
+
+def test_gauge_last_write_wins():
+    bank = scalar.init_gauges(4)
+    slots = np.array([2, 2, 2, 1, -1], np.int32)
+    vals = np.array([1.0, 5.0, 3.0, 9.0, 777.0], np.float32)
+    seqs = np.arange(5, dtype=np.int32)
+    bank = scalar.gauge_set(bank, slots, vals, seqs)
+    v = np.asarray(bank.value)
+    assert v[2] == 3.0  # last in batch order
+    assert v[1] == 9.0
+    assert np.asarray(bank.seq)[0] == -1
+
+    # an older batch (lower seqs) must not overwrite
+    bank = scalar.gauge_set(
+        bank, np.array([2], np.int32), np.array([42.0], np.float32),
+        np.array([0], np.int32))
+    assert np.asarray(bank.value)[2] == 3.0
+    # a newer one must
+    bank = scalar.gauge_set(
+        bank, np.array([2], np.int32), np.array([42.0], np.float32),
+        np.array([100], np.int32))
+    assert np.asarray(bank.value)[2] == 42.0
+
+
+def test_fnv_vectors():
+    # Known FNV-1a test vectors.
+    assert hashing.fnv1a_32(b"") == 0x811C9DC5
+    assert hashing.fnv1a_32(b"a") == 0xE40C292C
+    assert hashing.fnv1a_32(b"foobar") == 0xBF9CF968
+    assert hashing.fnv1a_64(b"") == 0xCBF29CE484222325
+    assert hashing.fnv1a_64(b"a") == 0xAF63DC4C8601EC8C
+    assert hashing.fnv1a_64(b"foobar") == 0x85944171F73967E8

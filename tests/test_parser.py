@@ -1,0 +1,132 @@
+"""Table-driven DogStatsD parser conformance tests (the strategy of
+samplers/parser_test.go: valid/invalid lines, events, service checks,
+scope tags)."""
+
+import pytest
+
+from veneur_tpu.ingest import parser
+from veneur_tpu.ingest.parser import (
+    GLOBAL_ONLY, LOCAL_ONLY, MIXED_SCOPE, ParseError)
+
+VALID = [
+    (b"a.b.c:1|c", "a.b.c", "counter", 1.0, 1.0, [], MIXED_SCOPE),
+    (b"a.b.c:-5.5|g", "a.b.c", "gauge", -5.5, 1.0, [], MIXED_SCOPE),
+    (b"req.time:12.5|ms", "req.time", "timer", 12.5, 1.0, [], MIXED_SCOPE),
+    (b"dist:3|h", "dist", "histogram", 3.0, 1.0, [], MIXED_SCOPE),
+    (b"dist:3|d", "dist", "histogram", 3.0, 1.0, [], GLOBAL_ONLY),
+    (b"hits:1|c|@0.1", "hits", "counter", 1.0, 0.1, [], MIXED_SCOPE),
+    (b"hits:1|c|#foo:bar,baz", "hits", "counter", 1.0, 1.0,
+     ["baz", "foo:bar"], MIXED_SCOPE),  # tags sorted
+    (b"hits:1|c|@0.5|#a:b", "hits", "counter", 1.0, 0.5, ["a:b"],
+     MIXED_SCOPE),
+    (b"hits:1|c|#tag,veneurlocalonly", "hits", "counter", 1.0, 1.0,
+     ["tag"], LOCAL_ONLY),
+    (b"t:4|ms|#veneurglobalonly", "t", "timer", 4.0, 1.0, [], GLOBAL_ONLY),
+    (b"c:1e3|c", "c", "counter", 1000.0, 1.0, [], MIXED_SCOPE),
+]
+
+
+@pytest.mark.parametrize(
+    "line,name,type_,value,rate,tags,scope", VALID,
+    ids=[v[0].decode() for v in VALID])
+def test_valid_metric(line, name, type_, value, rate, tags, scope):
+    m = parser.parse_metric(line)
+    assert m.key.name == name
+    assert m.key.type == type_
+    assert m.value == value
+    assert m.sample_rate == rate
+    assert m.tags == tags
+    assert m.scope == scope
+    assert m.key.joined_tags == ",".join(tags)
+
+
+def test_set_metric_keeps_string():
+    m = parser.parse_metric(b"users:alice|s")
+    assert m.key.type == "set"
+    assert m.value == "alice"
+
+
+INVALID = [
+    b"",
+    b"nocolon",
+    b":1|c",
+    b"a.b.c:1",            # no type
+    b"a.b.c:|c",           # empty value
+    b"a.b.c:xyz|c",        # non-numeric
+    b"a.b.c:1|q",          # bad type
+    b"a.b.c:1|c|@2.0",     # rate > 1
+    b"a.b.c:1|c|@0",       # rate 0
+    b"a.b.c:1|c|@0.5|@0.5",  # duplicate rate
+    b"a.b.c:1|c|#a|#b",    # duplicate tags
+    b"a.b.c:1|c|zzz",      # unknown section
+    b"a.b.c:1|c|",         # empty section
+    b"a.b.c:inf|c",        # non-finite
+    b"a.b.c:nan|g",        # non-finite
+    b"g:1|g|@0.5",         # rate on gauge
+    b"s:x|s|@0.5",         # rate on set
+]
+
+
+@pytest.mark.parametrize("line", INVALID, ids=[repr(l) for l in INVALID])
+def test_invalid_metric(line):
+    with pytest.raises(ParseError):
+        parser.parse_metric(line)
+
+
+def test_digest_depends_on_name_type_tags():
+    a = parser.parse_metric(b"x:1|c|#t:1")
+    b = parser.parse_metric(b"x:2|c|#t:1")   # value differs -> same key
+    c = parser.parse_metric(b"x:1|g|#t:1")   # type differs
+    d = parser.parse_metric(b"x:1|c|#t:2")   # tags differ
+    assert a.digest == b.digest
+    assert a.digest != c.digest
+    assert a.digest != d.digest
+    # scope tags are stripped and do NOT change the key
+    e = parser.parse_metric(b"x:1|c|#t:1,veneurglobalonly")
+    assert e.digest == a.digest
+
+
+def test_event():
+    ev = parser.parse_packet(
+        b"_e{5,4}:title|text|d:1234|h:host1|k:ak|p:low|s:src|t:error"
+        b"|#env:prod,team:obs")
+    assert ev.title == "title"
+    assert ev.text == "text"
+    assert ev.timestamp == 1234
+    assert ev.hostname == "host1"
+    assert ev.aggregation_key == "ak"
+    assert ev.priority == "low"
+    assert ev.source_type == "src"
+    assert ev.alert_type == "error"
+    assert ev.tags == ["env:prod", "team:obs"]
+
+
+def test_event_newline_escape_and_lengths():
+    ev = parser.parse_event(b"_e{2,6}:ab|c\\nd,e")
+    assert ev.title == "ab"
+    assert ev.text == "c\nd,e"
+    with pytest.raises(ParseError):
+        parser.parse_event(b"_e{5,4}:toolong")
+    with pytest.raises(ParseError):
+        parser.parse_event(b"_e{2,2}:abXcd")  # separator not where claimed
+
+
+def test_service_check():
+    sc = parser.parse_packet(
+        b"_sc|my.svc|1|d:999|h:web01|#a:b|m:it broke")
+    assert sc.name == "my.svc"
+    assert sc.status == 1
+    assert sc.timestamp == 999
+    assert sc.hostname == "web01"
+    assert sc.tags == ["a:b"]
+    assert sc.message == "it broke"
+    with pytest.raises(ParseError):
+        parser.parse_service_check(b"_sc|x|9")
+    with pytest.raises(ParseError):
+        parser.parse_service_check(b"_sc|x")
+
+
+def test_dispatch():
+    assert isinstance(parser.parse_packet(b"a:1|c"), parser.UDPMetric)
+    assert isinstance(parser.parse_packet(b"_e{1,1}:a|b"), parser.Event)
+    assert isinstance(parser.parse_packet(b"_sc|n|0"), parser.ServiceCheck)

@@ -1,0 +1,170 @@
+"""Parity tests for the batched t-digest bank.
+
+Mirrors the property-style strategy of tdigest/merging_digest_test.go:
+distributional quantile-error bounds, merge-of-shards == single digest,
+plus exact-aggregate checks, all against (a) numpy exact quantiles and
+(b) the OracleDigest port of the Go algorithm.
+"""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.ops import tdigest
+from oracle_tdigest import OracleDigest
+
+QS = np.array([0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99], np.float32)
+
+
+def _bank_quantiles(values, weights=None, compression=100.0, buf_size=256,
+                    batch=4096):
+    """Feed one slot of a 4-slot bank and return its quantiles."""
+    bank = tdigest.init(4, compression=compression, buf_size=buf_size)
+    n = len(values)
+    weights = np.ones(n, np.float32) if weights is None else weights
+    for i in range(0, n, batch):
+        v = np.asarray(values[i:i + batch], np.float32)
+        w = np.asarray(weights[i:i + batch], np.float32)
+        s = np.full(len(v), 1, np.int32)
+        bank = tdigest.add_batch(bank, s, v, w, compression=compression)
+    bank = tdigest.compress(bank, compression=compression)
+    out = np.asarray(tdigest.quantile(bank, QS))
+    return bank, out[1]
+
+
+@pytest.mark.parametrize("dist", ["uniform", "normal", "lognormal", "sequential"])
+def test_quantile_accuracy_vs_exact(dist):
+    rng = np.random.default_rng(42)
+    n = 50_000
+    if dist == "uniform":
+        data = rng.uniform(0, 100, n)
+    elif dist == "normal":
+        data = rng.normal(50, 10, n)
+    elif dist == "lognormal":
+        data = rng.lognormal(3, 1, n)
+    else:
+        data = np.arange(n, dtype=np.float64)
+    data = data.astype(np.float32)
+
+    _, got = _bank_quantiles(data)
+    exact = np.quantile(data, QS)
+    spread = exact.max() - exact.min()
+    # t-digest error bound: tight at tails, looser mid-distribution.
+    # 1% of spread everywhere is well within the reference's own error.
+    np.testing.assert_allclose(got, exact, atol=0.01 * spread + 1e-4)
+
+
+def test_parity_vs_go_oracle():
+    rng = np.random.default_rng(7)
+    data = rng.gamma(2.0, 30.0, 20_000).astype(np.float32)
+    _, got = _bank_quantiles(data)
+    oracle = OracleDigest()
+    for v in data:
+        oracle.add(float(v))
+    want = np.array([oracle.quantile(float(q)) for q in QS])
+    spread = data.max() - data.min()
+    # ±1% of spread parity with the Go-algorithm oracle (BASELINE target).
+    np.testing.assert_allclose(got, want, atol=0.01 * spread)
+
+
+def test_aggregates_exact():
+    rng = np.random.default_rng(3)
+    data = rng.uniform(1, 100, 10_000).astype(np.float32)
+    rates = np.full(len(data), 0.5, np.float32)  # sample_rate 0.5 -> weight 2
+    bank, _ = _bank_quantiles(data, weights=1.0 / rates)
+    agg = {k: np.asarray(v)[1] for k, v in tdigest.aggregates(bank).items()}
+    w = 2.0
+    assert agg["min"] == pytest.approx(data.min())
+    assert agg["max"] == pytest.approx(data.max())
+    assert agg["count"] == pytest.approx(w * len(data), rel=1e-6)
+    assert agg["sum"] == pytest.approx(w * data.sum(), rel=1e-4)
+    assert agg["avg"] == pytest.approx(data.mean(), rel=1e-4)
+    assert agg["hmean"] == pytest.approx(
+        len(data) / np.sum(1.0 / data), rel=1e-3)
+
+
+def test_merge_of_shards_matches_single():
+    """32 local shards merged into a global digest ~= one digest fed
+    everything (BASELINE config 4: forwardrpc merge of 32 shards)."""
+    rng = np.random.default_rng(11)
+    data = rng.normal(0, 1, 64_000).astype(np.float32)
+    shards = np.array_split(data, 32)
+
+    # Global bank receives each shard's centroids via merge_centroids.
+    comp = 100.0
+    glob = tdigest.init(2, compression=comp)
+    for sh in shards:
+        local = tdigest.init(1, compression=comp)
+        local = tdigest.add_batch(
+            local, np.zeros(len(sh), np.int32), sh,
+            np.ones(len(sh), np.float32), compression=comp)
+        local = tdigest.compress(local, compression=comp)
+        means = np.asarray(local.mean[0])
+        wts = np.asarray(local.weight[0])
+        slots = np.zeros(len(means), np.int32)
+        glob = tdigest.merge_centroids(glob, slots, means, wts)
+        glob = tdigest.merge_scalars(
+            glob, np.array([0], np.int32),
+            np.asarray(local.vmin[:1]), np.asarray(local.vmax[:1]),
+            np.asarray(local.vsum[:1]), np.asarray(local.count[:1]),
+            np.asarray(local.recip[:1]))
+        glob = tdigest.compress(glob, compression=comp)
+
+    got = np.asarray(tdigest.quantile(glob, QS))[0]
+    exact = np.quantile(data, QS)
+    spread = exact.max() - exact.min()
+    np.testing.assert_allclose(got, exact, atol=0.015 * spread)
+    agg = {k: np.asarray(v)[0] for k, v in tdigest.aggregates(glob).items()}
+    assert agg["count"] == pytest.approx(len(data))
+    assert agg["min"] == pytest.approx(data.min())
+    assert agg["max"] == pytest.approx(data.max())
+
+
+def test_buffer_overflow_single_hot_slot():
+    """A batch far larger than the buffer must be fully absorbed
+    (worker channel backpressure has no analogue here — no sample loss)."""
+    rng = np.random.default_rng(5)
+    data = rng.uniform(0, 1, 5_000).astype(np.float32)
+    bank = tdigest.init(2, buf_size=64)
+    bank = tdigest.add_batch(
+        bank, np.zeros(len(data), np.int32), data,
+        np.ones(len(data), np.float32))
+    bank = tdigest.compress(bank, compression=100.0)
+    assert np.asarray(bank.count)[0] == pytest.approx(len(data))
+    got = np.asarray(tdigest.quantile(bank, QS))[0]
+    np.testing.assert_allclose(got, np.quantile(data, QS), atol=0.02)
+
+
+def test_many_slots_and_padding():
+    rng = np.random.default_rng(9)
+    k = 64
+    per = 500
+    slots = np.repeat(np.arange(k, dtype=np.int32), per)
+    values = (slots.astype(np.float32) * 10.0
+              + rng.uniform(0, 1, k * per).astype(np.float32))
+    # interleave padding
+    pad = np.full(1000, -1, np.int32)
+    slots = np.concatenate([slots, pad])
+    values = np.concatenate([values, np.full(1000, 1e9, np.float32)])
+    perm = rng.permutation(len(slots))
+    slots, values = slots[perm], values[perm]
+
+    bank = tdigest.init(k)
+    bank = tdigest.add_batch(bank, slots, values,
+                             np.ones(len(slots), np.float32))
+    bank = tdigest.compress(bank, compression=100.0)
+    med = np.asarray(tdigest.quantile(bank, np.array([0.5], np.float32)))
+    cnt = np.asarray(bank.count)
+    assert np.all(cnt == per)
+    for i in range(k):
+        assert abs(med[i, 0] - (i * 10.0 + 0.5)) < 0.1
+
+
+def test_empty_bank():
+    bank = tdigest.init(3)
+    bank = tdigest.compress(bank, compression=100.0)
+    out = np.asarray(tdigest.quantile(bank, QS))
+    assert out.shape == (3, len(QS))
+    assert np.all(out == 0.0)
+    agg = tdigest.aggregates(bank)
+    assert np.all(np.asarray(agg["count"]) == 0.0)
+    assert np.all(np.asarray(agg["min"]) == 0.0)

@@ -1,0 +1,20 @@
+"""veneur_tpu — a TPU-native observability aggregation framework.
+
+A ground-up rebuild of the capabilities of segmentio/veneur (a distributed
+DogStatsD/SSF metrics pipeline with globally-accurate percentiles and set
+cardinalities) whose aggregation engine runs as XLA-compiled streaming-sketch
+kernels on TPU (JAX/pjit) instead of Go goroutines.
+
+Reference parity map (see SURVEY.md):
+  - veneur_tpu.ops.tdigest    <->  tdigest/merging_digest.go (sym: MergingDigest)
+  - veneur_tpu.ops.hll        <->  samplers.Set's vendored axiomhq/hyperloglog
+  - veneur_tpu.ops.scalar     <->  samplers.Counter / samplers.Gauge
+  - veneur_tpu.models         <->  worker.go (sym: Worker), flusher.go
+  - veneur_tpu.ingest         <->  samplers/parser.go, networking.go
+  - veneur_tpu.sinks          <->  sinks/ (sym: MetricSink, SpanSink)
+  - veneur_tpu.cluster        <->  forwardrpc/, importsrv/, proxysrv/, discovery.go
+  - veneur_tpu.trace          <->  trace/ (SSF client library)
+  - veneur_tpu.config         <->  config.go (sym: Config, ReadConfig)
+"""
+
+__version__ = "0.1.0"

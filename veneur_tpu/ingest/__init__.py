@@ -1,0 +1,5 @@
+"""Ingest layer: DogStatsD parsing, UDP/TCP/UNIX listeners, SSF framing.
+
+Host-side analogue of networking.go (sym: Server.ReadMetricSocket),
+samplers/parser.go, and protocol/ in the reference.
+"""

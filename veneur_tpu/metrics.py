@@ -1,0 +1,42 @@
+"""Core metric value types shared across the pipeline.
+
+Parity: samplers/samplers.go (sym: InterMetric, MetricScope) — the flushed
+representation handed to sinks — and samplers/metricpb's wire shapes for
+forwarded aggregates (re-expressed in veneur_tpu.cluster.wire).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+
+class MetricType(IntEnum):
+    COUNTER = 0
+    GAUGE = 1
+    HISTOGRAM = 2
+    SET = 3
+    TIMER = 4
+    STATUS = 5
+
+
+@dataclass
+class InterMetric:
+    """One flushed metric handed to MetricSink.Flush — the unit of egress
+    (samplers.InterMetric)."""
+    name: str
+    timestamp: int          # unix seconds
+    value: float
+    tags: list[str] = field(default_factory=list)
+    type: MetricType = MetricType.GAUGE
+    message: str = ""
+    hostname: str = ""
+    sinks: list[str] = field(default_factory=list)  # empty = all sinks
+
+
+@dataclass
+class SampleBatchStats:
+    """Per-flush ingest bookkeeping, reported as veneur.* self-metrics."""
+    samples: int = 0
+    dropped_no_slot: int = 0
+    parse_errors: int = 0

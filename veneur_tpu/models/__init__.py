@@ -1,0 +1,6 @@
+"""The aggregation engine — the framework's flagship "model".
+
+Replaces the reference's Worker goroutines + flusher (worker.go sym: Worker;
+flusher.go sym: Server.Flush) with device-resident sketch banks driven by
+batched XLA programs.
+"""

@@ -1,0 +1,487 @@
+"""AggregationEngine — banks + staging + the jitted flush program.
+
+This is the TPU-native replacement for the reference's hot path from
+Worker.ProcessMetric down through Server.Flush (worker.go, flusher.go):
+
+  ingest thread:  parsed UDPMetric -> host staging buffers (numpy, fixed
+                  batch shape) -> one scatter program per full batch
+  flush tick:     compress + quantiles + aggregates + estimates as a few
+                  large XLA calls over the whole bank -> device_get once ->
+                  host assembles InterMetrics from the slot->key map
+
+Interval semantics match Worker.Flush's map swap: flush takes the current
+immutable device arrays (JAX arrays are persistent, so the "swap" is just
+rebinding fresh banks) and ingest continues immediately; the merge program
+runs on the snapshot — double buffering for free.
+
+Scope routing (flusher.go semantics):
+  * no forwarding configured -> everything flushes locally in full.
+  * forwarding on: mixed-scope histograms/timers emit the configured local
+    aggregates and forward their digest (percentiles are computed globally);
+    mixed sets forward the sketch; `veneurlocalonly` keys flush fully
+    locally; `veneurglobalonly` keys only forward. Counters/gauges stay
+    local unless global-only.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field as dc_field
+
+import jax
+import numpy as np
+
+from ..ingest.parser import (
+    GLOBAL_ONLY, LOCAL_ONLY, MetricKey, UDPMetric)
+from ..metrics import InterMetric, MetricType
+from ..ops import hll, scalar, tdigest
+from ..utils import hashing
+from .worker import KeyInterner
+
+
+@dataclass
+class EngineConfig:
+    histogram_slots: int = 1 << 15
+    counter_slots: int = 1 << 14
+    gauge_slots: int = 1 << 14
+    set_slots: int = 1 << 12
+    compression: float = 100.0
+    buffer_depth: int = 256
+    hll_precision: int = 14
+    batch_size: int = 8192
+    percentiles: tuple = (0.5, 0.75, 0.99)
+    aggregates: tuple = ("min", "max", "count")
+    idle_ttl_intervals: int = 16
+    forward_enabled: bool = False
+    is_global: bool = False      # global tier: emit percentiles for imports
+    hostname: str = ""
+    host_tags: tuple = ()
+
+
+@dataclass
+class ForwardExport:
+    """Global-scope state to send upstream, one entry per key — the
+    Export()/Metric() payloads of samplers (samplers.go sym: Histo.Metric,
+    Set.Export, Counter.Export)."""
+    histograms: list = dc_field(default_factory=list)
+    # (key, means f32[n], weights f32[n], min, max, sum, count, recip)
+    sets: list = dc_field(default_factory=list)        # (key, registers u8[m])
+    counters: list = dc_field(default_factory=list)    # (key, value)
+    gauges: list = dc_field(default_factory=list)      # (key, value)
+
+
+@dataclass
+class FlushResult:
+    metrics: list
+    export: ForwardExport
+    stats: dict
+
+
+class _Stage:
+    """Fixed-shape numpy staging buffer feeding one scatter kernel."""
+
+    def __init__(self, batch_size, fields):
+        self.n = 0
+        self.batch_size = batch_size
+        self.arrays = {
+            name: np.full(batch_size, fill, dtype)
+            for name, (dtype, fill) in fields.items()}
+
+    def full(self):
+        return self.n >= self.batch_size
+
+    def put(self, **vals):
+        i = self.n
+        for k, v in vals.items():
+            self.arrays[k][i] = v
+        self.n = i + 1
+
+    def drain(self):
+        """Return padded arrays and reset. Rows past self.n keep their
+        fill value (slot -1 => dropped by the kernels)."""
+        out = {k: a.copy() for k, a in self.arrays.items()}
+        n = self.n
+        if n < self.batch_size:
+            out["slots"][n:] = -1
+        self.n = 0
+        return out
+
+
+class AggregationEngine:
+    def __init__(self, config: EngineConfig | None = None):
+        self.cfg = config or EngineConfig()
+        cfg = self.cfg
+        self.histo_bank = tdigest.init(
+            cfg.histogram_slots, cfg.compression, cfg.buffer_depth)
+        self.counter_bank = scalar.init_counters(cfg.counter_slots)
+        self.gauge_bank = scalar.init_gauges(cfg.gauge_slots)
+        self.set_bank = hll.init(cfg.set_slots, cfg.hll_precision)
+
+        self.histo_keys = KeyInterner(cfg.histogram_slots,
+                                      cfg.idle_ttl_intervals)
+        self.counter_keys = KeyInterner(cfg.counter_slots,
+                                        cfg.idle_ttl_intervals)
+        self.gauge_keys = KeyInterner(cfg.gauge_slots,
+                                      cfg.idle_ttl_intervals)
+        self.set_keys = KeyInterner(cfg.set_slots, cfg.idle_ttl_intervals)
+
+        b = cfg.batch_size
+        f32, i32 = (np.float32, 0.0), (np.int32, 0)
+        self._histo_stage = _Stage(b, {"slots": (np.int32, -1),
+                                       "values": f32, "weights": f32})
+        self._counter_stage = _Stage(b, {"slots": (np.int32, -1),
+                                         "values": f32, "weights": f32})
+        self._gauge_stage = _Stage(b, {"slots": (np.int32, -1),
+                                       "values": f32, "seqs": i32})
+        self._set_stage = _Stage(b, {"slots": (np.int32, -1),
+                                     "reg_idx": i32, "rho": (np.uint8, 0)})
+        self._gauge_seq = 0
+        # Quantile program input: configured percentiles, plus 0.5 when the
+        # `median` aggregate is requested (veneur's median IS quantile(0.5)).
+        qs = list(cfg.percentiles)
+        self._median_idx = None
+        if "median" in cfg.aggregates:
+            self._median_idx = len(qs)
+            qs.append(0.5)
+        self._qs = np.asarray(qs, np.float32)
+        # %g formatting matches veneur's suffixes ("99percentile",
+        # "99.9percentile") and avoids int() truncation (0.29 -> 28).
+        self._pct_names = [f".{p * 100:g}percentile" for p in cfg.percentiles]
+        self.samples_processed = 0
+        # Imported (Combine) staging for the global tier — everything is
+        # batched so a 32-shard import costs a handful of device calls,
+        # not one per key.
+        self._import_centroids: list = []
+        self._import_sets: list = []          # (slot, registers u8[m])
+        self._import_counter_acc: dict = {}   # slot -> host f64 sum
+        self._import_gauge_acc: dict = {}     # slot -> last value
+        self._pending_events: list = []
+        self._pending_checks: list = []
+
+    # ---------------- ingest ----------------
+
+    def process(self, m: UDPMetric):
+        """Route one parsed sample to its bank's staging buffer — the
+        Worker.ProcessMetric equivalent."""
+        t = m.key.type
+        self.samples_processed += 1
+        if t in ("timer", "histogram"):
+            slot = self.histo_keys.lookup(m.key, m.scope)
+            if slot < 0:
+                return
+            st = self._histo_stage
+            st.put(slots=slot, values=m.value, weights=1.0 / m.sample_rate)
+            if st.full():
+                self._dispatch_histos()
+        elif t == "counter":
+            slot = self.counter_keys.lookup(m.key, m.scope)
+            if slot < 0:
+                return
+            st = self._counter_stage
+            st.put(slots=slot, values=m.value, weights=1.0 / m.sample_rate)
+            if st.full():
+                self._dispatch_counters()
+        elif t == "gauge":
+            slot = self.gauge_keys.lookup(m.key, m.scope)
+            if slot < 0:
+                return
+            st = self._gauge_stage
+            self._gauge_seq += 1
+            st.put(slots=slot, values=m.value, seqs=self._gauge_seq)
+            if st.full():
+                self._dispatch_gauges()
+        elif t == "set":
+            slot = self.set_keys.lookup(m.key, m.scope)
+            if slot < 0:
+                return
+            # Inline int bit ops (no numpy round-trip) — this is the
+            # per-sample hot path.
+            p = self.cfg.hll_precision
+            h = hashing.set_member_hash(str(m.value))
+            idx = h >> (64 - p)
+            rest = ((h << p) & 0xFFFFFFFFFFFFFFFF) | ((1 << p) - 1)
+            rho = 65 - rest.bit_length()  # clz + 1; sentinel caps range
+            st = self._set_stage
+            st.put(slots=slot, reg_idx=idx, rho=rho)
+            if st.full():
+                self._dispatch_sets()
+
+    def process_event(self, ev):
+        self._pending_events.append(ev)
+
+    def process_service_check(self, sc):
+        self._pending_checks.append(sc)
+
+    def _dispatch_histos(self):
+        a = self._histo_stage.drain()
+        self.histo_bank = tdigest.add_batch(
+            self.histo_bank, a["slots"], a["values"], a["weights"],
+            compression=self.cfg.compression)
+
+    def _dispatch_counters(self):
+        a = self._counter_stage.drain()
+        self.counter_bank = scalar.counter_add(
+            self.counter_bank, a["slots"], a["values"], a["weights"])
+
+    def _dispatch_gauges(self):
+        a = self._gauge_stage.drain()
+        self.gauge_bank = scalar.gauge_set(
+            self.gauge_bank, a["slots"], a["values"], a["seqs"])
+
+    def _dispatch_sets(self):
+        a = self._set_stage.drain()
+        self.set_bank = hll.insert(
+            self.set_bank, a["slots"], a["reg_idx"], a["rho"])
+
+    def drain_all(self):
+        for st, fn in ((self._histo_stage, self._dispatch_histos),
+                       (self._counter_stage, self._dispatch_counters),
+                       (self._gauge_stage, self._dispatch_gauges),
+                       (self._set_stage, self._dispatch_sets)):
+            if st.n:
+                fn()
+
+    # ---------------- import (global tier Combine path) ----------------
+
+    def import_histogram(self, key: MetricKey, means, weights, vmin, vmax,
+                         vsum, count, recip=0.0):
+        """Stage a forwarded digest for merging — Histo.Combine
+        (importsrv path, worker.go sym: Worker.ImportMetricGRPC)."""
+        slot = self.histo_keys.lookup(key, GLOBAL_ONLY)
+        if slot < 0:
+            return
+        self._import_centroids.append(
+            (slot, np.asarray(means, np.float32),
+             np.asarray(weights, np.float32),
+             float(vmin), float(vmax), float(vsum), float(count),
+             float(recip)))
+        if len(self._import_centroids) >= 512:
+            self._flush_import_centroids()
+
+    def import_set(self, key: MetricKey, registers):
+        slot = self.set_keys.lookup(key, GLOBAL_ONLY)
+        if slot < 0:
+            return
+        self._import_sets.append((slot, np.asarray(registers, np.uint8)))
+        if len(self._import_sets) >= 256:
+            self._flush_import_sets()
+
+    def import_counter(self, key: MetricKey, value: float):
+        slot = self.counter_keys.lookup(key, GLOBAL_ONLY)
+        if slot < 0:
+            return
+        # Host-side f64 accumulation — exact, and one device call per flush.
+        self._import_counter_acc[slot] = (
+            self._import_counter_acc.get(slot, 0.0) + float(value))
+
+    def import_gauge(self, key: MetricKey, value: float):
+        slot = self.gauge_keys.lookup(key, GLOBAL_ONLY)
+        if slot < 0:
+            return
+        self._import_gauge_acc[slot] = float(value)  # last write wins
+
+    def _flush_import_sets(self):
+        if not self._import_sets:
+            return
+        items, self._import_sets = self._import_sets, []
+        self.set_bank = hll.merge_rows(
+            self.set_bank,
+            np.array([s for s, _ in items], np.int32),
+            np.stack([r for _, r in items]))
+
+    def _flush_import_scalars(self):
+        if self._import_counter_acc:
+            acc, self._import_counter_acc = self._import_counter_acc, {}
+            self.counter_bank = scalar.counter_merge(
+                self.counter_bank,
+                np.fromiter(acc.keys(), np.int32, len(acc)),
+                np.fromiter(acc.values(), np.float32, len(acc)))
+        if self._import_gauge_acc:
+            acc, self._import_gauge_acc = self._import_gauge_acc, {}
+            seqs = np.arange(len(acc), dtype=np.int32) + self._gauge_seq + 1
+            self._gauge_seq += len(acc)
+            self.gauge_bank = scalar.gauge_set(
+                self.gauge_bank,
+                np.fromiter(acc.keys(), np.int32, len(acc)),
+                np.fromiter(acc.values(), np.float32, len(acc)), seqs)
+
+    def _flush_import_centroids(self):
+        """Merge staged foreign digests with the minimum number of
+        compress passes: one upfront (so buffer fill is known-zero), then
+        again only when some slot's buffered centroid count would exceed
+        the buffer depth — cost scales with imported data, not with K per
+        chunk."""
+        if not self._import_centroids:
+            return
+        items = self._import_centroids
+        self._import_centroids = []
+        B = self.cfg.buffer_depth
+        comp = self.cfg.compression
+        self.histo_bank = tdigest.compress(self.histo_bank, compression=comp)
+
+        pending: dict[int, int] = {}
+        batch: list = []
+
+        def emit():
+            if not batch:
+                return
+            self.histo_bank = tdigest.merge_centroids(
+                self.histo_bank,
+                np.concatenate([np.full(len(m), s, np.int32)
+                                for s, m, _ in batch]),
+                np.concatenate([m for _, m, _ in batch]),
+                np.concatenate([w for _, _, w in batch]))
+            batch.clear()
+
+        for s, means, weights, *_ in items:
+            n = len(means)
+            if pending.get(s, 0) + n > B:
+                emit()
+                self.histo_bank = tdigest.compress(
+                    self.histo_bank, compression=comp)
+                pending.clear()
+            # a single digest larger than B (can't happen with matching
+            # compression, but forwarded payloads are untrusted) is
+            # split across compress passes
+            while n > B:
+                batch.append((s, means[:B], weights[:B]))
+                emit()
+                self.histo_bank = tdigest.compress(
+                    self.histo_bank, compression=comp)
+                means, weights = means[B:], weights[B:]
+                n = len(means)
+            batch.append((s, means, weights))
+            pending[s] = pending.get(s, 0) + n
+        emit()
+
+        sl = np.array([it[0] for it in items], np.int32)
+        self.histo_bank = tdigest.merge_scalars(
+            self.histo_bank, sl,
+            np.array([it[3] for it in items], np.float32),
+            np.array([it[4] for it in items], np.float32),
+            np.array([it[5] for it in items], np.float32),
+            np.array([it[6] for it in items], np.float32),
+            np.array([it[7] for it in items], np.float32))
+
+    # ---------------- flush ----------------
+
+    def flush(self, timestamp: int | None = None) -> FlushResult:
+        """The Server.Flush equivalent: snapshot banks, run the merge
+        program, assemble InterMetrics + forward exports, reset state."""
+        ts = int(timestamp if timestamp is not None else time.time())
+        cfg = self.cfg
+        self.drain_all()
+        self._flush_import_centroids()
+        self._flush_import_sets()
+        self._flush_import_scalars()
+
+        # Snapshot current banks (immutable arrays) and hand ingest fresh
+        # ones — the Worker.Flush swap.
+        hb, cb, gb, sb = (self.histo_bank, self.counter_bank,
+                          self.gauge_bank, self.set_bank)
+        self.histo_bank = tdigest.reset(hb)
+        self.counter_bank = scalar.reset_counters(cb)
+        self.gauge_bank = scalar.reset_gauges(gb)
+        self.set_bank = hll.reset(sb)
+        self._gauge_seq = 0
+
+        hb = tdigest.compress(hb, compression=cfg.compression)
+        device = {
+            "q": tdigest.quantile(hb, self._qs),
+            "agg": tdigest.aggregates(hb),
+            "h_mean": hb.mean, "h_weight": hb.weight,
+            "h_min": hb.vmin, "h_max": hb.vmax, "h_sum": hb.vsum,
+            "h_count": hb.count, "h_recip": hb.recip,
+            "c_hi": cb.hi, "c_lo": cb.lo,
+            "g_value": gb.value, "g_seq": gb.seq,
+            "s_est": hll.estimate(sb),
+            "s_regs": sb.registers,
+        }
+        host = jax.device_get(device)
+
+        out: list[InterMetric] = []
+        export = ForwardExport()
+        fwd = cfg.forward_enabled
+
+        def emit(key, suffix, value, mtype):
+            tags = key.joined_tags.split(",") if key.joined_tags else []
+            out.append(InterMetric(
+                name=key.name + suffix, timestamp=ts, value=float(value),
+                tags=tags, type=mtype, hostname=cfg.hostname))
+
+        agg = host["agg"]
+        for key, slot in self.histo_keys.active_items():
+            scope = self.histo_keys.scope_of(slot)
+            if float(agg["count"][slot]) <= 0:
+                continue
+            forward_it = fwd and scope != LOCAL_ONLY
+            local_full = (not fwd) or scope == LOCAL_ONLY or cfg.is_global
+            if forward_it and not cfg.is_global:
+                w = host["h_weight"][slot]
+                nz = w > 0
+                export.histograms.append((
+                    key, host["h_mean"][slot][nz], w[nz],
+                    float(host["h_min"][slot]), float(host["h_max"][slot]),
+                    float(host["h_sum"][slot]),
+                    float(host["h_count"][slot]),
+                    float(host["h_recip"][slot])))
+                if scope == GLOBAL_ONLY:
+                    continue
+            if local_full:
+                for pi, pname in enumerate(self._pct_names):
+                    emit(key, pname, host["q"][slot][pi], MetricType.GAUGE)
+                if self._median_idx is not None:
+                    emit(key, ".median", host["q"][slot][self._median_idx],
+                         MetricType.GAUGE)
+            for name in cfg.aggregates:
+                if name in agg:
+                    val = agg[name][slot]
+                    mt = (MetricType.COUNTER if name == "count"
+                          else MetricType.GAUGE)
+                    emit(key, f".{name}", val, mt)
+
+        for key, slot in self.counter_keys.active_items():
+            scope = self.counter_keys.scope_of(slot)
+            total = float(host["c_hi"][slot]) + float(host["c_lo"][slot])
+            if fwd and scope == GLOBAL_ONLY and not cfg.is_global:
+                export.counters.append((key, total))
+            else:
+                emit(key, "", total, MetricType.COUNTER)
+
+        for key, slot in self.gauge_keys.active_items():
+            scope = self.gauge_keys.scope_of(slot)
+            if host["g_seq"][slot] < 0:
+                continue
+            val = float(host["g_value"][slot])
+            if fwd and scope == GLOBAL_ONLY and not cfg.is_global:
+                export.gauges.append((key, val))
+            else:
+                emit(key, "", val, MetricType.GAUGE)
+
+        for key, slot in self.set_keys.active_items():
+            scope = self.set_keys.scope_of(slot)
+            forward_it = fwd and scope != LOCAL_ONLY and not cfg.is_global
+            if forward_it:
+                export.sets.append((key, host["s_regs"][slot]))
+            else:
+                emit(key, "", host["s_est"][slot], MetricType.GAUGE)
+
+        stats = {
+            "samples": self.samples_processed,
+            "histo_keys": len(self.histo_keys),
+            "dropped_no_slot": (
+                self.histo_keys.dropped_no_slot
+                + self.counter_keys.dropped_no_slot
+                + self.gauge_keys.dropped_no_slot
+                + self.set_keys.dropped_no_slot),
+        }
+        self.samples_processed = 0
+        for ki in (self.histo_keys, self.counter_keys, self.gauge_keys,
+                   self.set_keys):
+            ki.advance_interval()
+        return FlushResult(metrics=out, export=export, stats=stats)
+
+    def drain_events(self):
+        evs, self._pending_events = self._pending_events, []
+        chks, self._pending_checks = self._pending_checks, []
+        return evs, chks

@@ -1,0 +1,89 @@
+"""Host-side key management: interning MetricKeys to device slots.
+
+The reference shards metrics onto workers by digest and each worker owns Go
+maps keyed by MetricKey (worker.go sym: WorkerMetrics, Worker.ProcessMetric).
+Here the device owns fixed-K banks, so the host keeps the (only) string-keyed
+structure: MetricKey -> slot, with a free list and idle-interval eviction to
+survive unbounded key churn against fixed K (SURVEY §7 "slot management").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..ingest.parser import MetricKey
+
+
+@dataclass
+class SlotInfo:
+    slot: int
+    last_interval: int
+    scope: int
+
+
+class KeyInterner:
+    """MetricKey -> slot map for one bank, with eviction.
+
+    Not thread-safe by design: one interner is owned by one ingest thread,
+    mirroring the single-goroutine ownership of WorkerMetrics maps.
+    """
+
+    def __init__(self, capacity: int, idle_ttl_intervals: int = 16):
+        self.capacity = capacity
+        self.idle_ttl = idle_ttl_intervals
+        self._map: dict[MetricKey, SlotInfo] = {}
+        self._free = list(range(capacity - 1, -1, -1))
+        self._by_slot: list[MetricKey | None] = [None] * capacity
+        self.interval = 0
+        self.dropped_no_slot = 0
+
+    def __len__(self):
+        return len(self._map)
+
+    def lookup(self, key: MetricKey, scope: int) -> int:
+        """Return the slot for `key`, allocating if new. -1 if the bank is
+        full (caller counts the drop — the analogue of worker channel
+        backpressure drops, which veneur also counts rather than blocks)."""
+        info = self._map.get(key)
+        if info is not None:
+            info.last_interval = self.interval
+            info.scope = scope
+            return info.slot
+        if not self._free:
+            self.dropped_no_slot += 1
+            return -1
+        slot = self._free.pop()
+        self._map[key] = SlotInfo(slot, self.interval, scope)
+        self._by_slot[slot] = key
+        return slot
+
+    def key_of(self, slot: int) -> MetricKey | None:
+        return self._by_slot[slot]
+
+    def scope_of(self, slot: int) -> int:
+        key = self._by_slot[slot]
+        return self._map[key].scope if key is not None else 0
+
+    def active_items(self):
+        """(key, slot) pairs touched in the *current* interval — the set a
+        flush reports (bank state is interval-scoped, so stale slots hold
+        zeros and are skipped)."""
+        cur = self.interval
+        return [(k, i.slot) for k, i in self._map.items()
+                if i.last_interval == cur]
+
+    def advance_interval(self):
+        """Called at each flush boundary: ages entries and evicts those
+        idle longer than the TTL, returning their slots to the free list."""
+        self.interval += 1
+        if self.idle_ttl <= 0:
+            return
+        horizon = self.interval - self.idle_ttl
+        if horizon < 0:
+            return
+        dead = [k for k, info in self._map.items()
+                if info.last_interval < horizon]
+        for k in dead:
+            info = self._map.pop(k)
+            self._by_slot[info.slot] = None
+            self._free.append(info.slot)

@@ -1,0 +1,8 @@
+"""Batched streaming-sketch kernels (the L0 of the framework).
+
+Everything in this package is pure JAX on fixed-shape arrays: sketch *banks*
+batched over a slot axis K (one slot = one distinct metric key), so that the
+whole per-interval aggregation — the work done sample-by-sample inside
+veneur's Worker goroutines (worker.go sym: Worker.ProcessMetric) — becomes a
+handful of large XLA programs.
+"""

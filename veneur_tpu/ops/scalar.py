@@ -1,0 +1,127 @@
+"""Counter and gauge banks — the scalar samplers as batched scatters.
+
+Reference: samplers/samplers.go (sym: Counter.Sample — `value += v * 1/rate`,
+flushed per interval; Gauge.Sample — last write wins; Counter.Combine /
+Gauge.Combine for the forwarded global variants).
+
+The reference accumulates counters in float64. JAX's default f32 loses
+integer exactness past 2^24 (a single counter can see >10M samples per
+interval), and enabling global x64 would poison every other kernel's
+dtypes, so counters use a compensated (2Sum) f32 hi/lo pair: each batch is
+segment-summed into a dense f32 delta (per-batch sums are small and exact
+enough), then folded into the pair with an error-free transformation —
+f64-grade totals with pure f32 ops.
+
+Gauges keep f32 plus an i32 sequence number so last-write-wins holds across
+batches and across forwarded merges.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import scatter
+
+
+class CounterBank(NamedTuple):
+    hi: jax.Array  # f32[K]
+    lo: jax.Array  # f32[K] compensation term
+
+    @property
+    def num_slots(self):
+        return self.hi.shape[0]
+
+
+class GaugeBank(NamedTuple):
+    value: jax.Array  # f32[K]
+    seq: jax.Array    # i32[K], last writer's sequence, -1 == never written
+
+    @property
+    def num_slots(self):
+        return self.value.shape[0]
+
+
+def init_counters(num_slots: int) -> CounterBank:
+    # hi and lo must be distinct buffers: the bank is donated to the
+    # scatter kernels, and XLA rejects donating one buffer twice.
+    return CounterBank(hi=jnp.zeros((num_slots,), jnp.float32),
+                       lo=jnp.zeros((num_slots,), jnp.float32))
+
+
+def init_gauges(num_slots: int) -> GaugeBank:
+    return GaugeBank(value=jnp.zeros((num_slots,), jnp.float32),
+                     seq=jnp.full((num_slots,), -1, jnp.int32))
+
+
+def _two_sum(a, b):
+    """Error-free transformation: a + b = s + err exactly (Knuth 2Sum)."""
+    s = a + b
+    a2 = s - b
+    b2 = s - a2
+    err = (a - a2) + (b - b2)
+    return s, err
+
+
+def _fold(bank: CounterBank, delta) -> CounterBank:
+    s, err = _two_sum(bank.hi, delta + bank.lo)
+    return CounterBank(hi=s, lo=err)
+
+
+@partial(jax.jit, donate_argnames=("bank",))
+def counter_add(bank: CounterBank, slots, values, weights) -> CounterBank:
+    """Batched Counter.Sample: value[slot] += v * weight (weight = 1/rate)."""
+    K = bank.num_slots
+    row = jnp.where(slots >= 0, slots, K)
+    delta = jnp.zeros((K,), jnp.float32).at[row].add(
+        (values * weights).astype(jnp.float32), mode="drop")
+    return _fold(bank, delta)
+
+
+@partial(jax.jit, donate_argnames=("bank",))
+def counter_merge(bank: CounterBank, slots, values) -> CounterBank:
+    """Combine forwarded counter values (global counters: the cross-chip
+    union is a psum of the same delta layout)."""
+    K = bank.num_slots
+    row = jnp.where(slots >= 0, slots, K)
+    delta = jnp.zeros((K,), jnp.float32).at[row].add(
+        values.astype(jnp.float32), mode="drop")
+    return _fold(bank, delta)
+
+
+def counter_totals(bank: CounterBank):
+    """Read totals with the compensation folded back in (host side does
+    float64(hi) + float64(lo) for full precision)."""
+    return bank.hi, bank.lo
+
+
+@partial(jax.jit, donate_argnames=("bank",))
+def gauge_set(bank: GaugeBank, slots, values, seqs) -> GaugeBank:
+    """Batched Gauge.Sample with last-write-wins.
+
+    `seqs` is a monotonically increasing host-assigned sequence per sample;
+    within a batch the per-slot max-seq sample wins, and across batches /
+    forwarded merges the stored seq arbitrates.
+    """
+    K = bank.num_slots
+    s, v, q = scatter.sort_by_slot(slots, values, seqs)
+    last = scatter.run_lasts(s) & (s >= 0)  # stable sort => last == max seq
+    row = jnp.where(last, s, K)
+    new_seq = bank.seq.at[row].max(q, mode="drop")
+    won = last & (q >= new_seq[jnp.clip(s, 0, K - 1)])
+    row_w = jnp.where(won, s, K)
+    return GaugeBank(value=bank.value.at[row_w].set(v, mode="drop"),
+                     seq=new_seq)
+
+
+def reset_counters(bank: CounterBank) -> CounterBank:
+    return init_counters(bank.num_slots)
+
+
+def reset_gauges(bank: GaugeBank) -> GaugeBank:
+    """Gauges are last-write-wins *within* an interval; a gauge is
+    re-reported only when sampled again, so interval reset clears the seq."""
+    return init_gauges(bank.num_slots)

@@ -1,0 +1,55 @@
+"""Shared scatter/segmented-batch utilities for the sketch banks.
+
+A device batch is a fixed-shape set of parallel arrays (slots[N], values[N],
+weights[N]) where slot == -1 marks padding. Every bank turns a batch into
+vectorized scatters; the helpers here compute per-slot ranks (position of a
+sample among the samples of the same slot within the batch), which is what
+lets a scatter into per-slot ring buffers be expressed with static shapes.
+
+The reference processes one sample at a time on the owning goroutine
+(worker.go sym: Worker.ProcessMetric); here the same routing is a sort by
+slot id plus rank arithmetic, done once per batch for the whole batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_by_slot(slots, *arrays):
+    """Stable-sort a batch by slot id. Padding (slot < 0) is mapped to a
+    large id so it sorts to the end. Returns (sorted_slots, *sorted_arrays)
+    with padding slots restored to -1."""
+    n = slots.shape[0]
+    key = jnp.where(slots < 0, jnp.iinfo(jnp.int32).max, slots)
+    order = jnp.argsort(key, stable=True)
+    out = tuple(a[order] for a in arrays)
+    s = slots[order]
+    return (s,) + out
+
+
+def run_ranks(sorted_slots):
+    """Given slot ids sorted ascending, return the 0-based rank of each
+    element within its run of equal ids."""
+    n = sorted_slots.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_slots[1:] != sorted_slots[:-1]]
+    )
+    run_start = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    return idx - run_start
+
+
+def run_lasts(sorted_slots):
+    """Boolean mask marking the last element of each run of equal slot ids
+    (used for last-write-wins gauge semantics)."""
+    return jnp.concatenate(
+        [sorted_slots[:-1] != sorted_slots[1:], jnp.ones((1,), jnp.bool_)]
+    )
+
+
+def segment_count(slots, mask, num_slots):
+    """Count of True-mask samples per slot, dropping out-of-range ids."""
+    idx = jnp.where(mask, slots, num_slots)  # OOB scatter index -> dropped
+    return jnp.zeros((num_slots,), jnp.int32).at[idx].add(1, mode="drop")
