@@ -1,0 +1,99 @@
+"""Benchmark: p99 flush-merge latency @100k distinct histograms.
+
+The BASELINE.json north-star number: p99 flush-merge < 50 ms on TPU for
+100k distinct histogram keys (the reference's Server.Flush merge/quantile
+loop at the same cardinality, which it performs in Go over per-key
+MergingDigests). Prints ONE JSON line:
+  {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": 50/p99}
+vs_baseline > 1 means the target is beaten by that factor.
+
+Runs on the real TPU chip (the tunneled "axon" platform) when available;
+falls back to CPU with a note in the metric name rather than crashing.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+K = 100_000
+COMPRESSION = 100.0
+BUF = 256
+N_PREFILL_BATCHES = 16
+BATCH = 131_072
+ITERS = 40
+TARGET_MS = 50.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    platform = "tpu"
+    try:
+        devs = jax.devices()
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        platform = "cpu-fallback"
+    dev = devs[0]
+
+    from veneur_tpu.ops import tdigest
+
+    # Build the pre-flush state host-side (full sample buffers for every
+    # slot — the worst-case merge input) and ship it once: avoids paying
+    # the ingest program's compile through the tunnel; the benched
+    # program is the full flush merge (sort + cluster + quantiles).
+    rng = np.random.default_rng(0)
+    proto = tdigest.init(1, compression=COMPRESSION, buf_size=BUF)
+    C = proto.num_centroids
+    buf_value = rng.gamma(2.0, 20.0, (K, BUF)).astype(np.float32)
+    bank = tdigest.TDigestBank(
+        mean=np.zeros((K, C), np.float32),
+        weight=np.zeros((K, C), np.float32),
+        buf_value=buf_value,
+        buf_weight=np.ones((K, BUF), np.float32),
+        buf_n=np.full((K,), BUF, np.int32),
+        vmin=buf_value.min(axis=1),
+        vmax=buf_value.max(axis=1),
+        vsum=buf_value.sum(axis=1),
+        count=np.full((K,), float(BUF), np.float32),
+        recip=(1.0 / buf_value).sum(axis=1),
+    )
+    bank = jax.device_put(bank, dev)
+    jax.block_until_ready(bank.mean)
+
+    qs = jnp.asarray([0.5, 0.75, 0.99], jnp.float32)
+
+    @jax.jit
+    def flush_merge(b, qs):
+        merged = tdigest._compress_impl(b, COMPRESSION)
+        return (tdigest.quantile(merged, qs), tdigest.aggregates(merged))
+
+    # warm up / compile
+    out = flush_merge(bank, qs)
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        out = flush_merge(bank, qs)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1000.0)
+    times.sort()
+    p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+
+    print(json.dumps({
+        "metric": f"flush_merge_p99_ms_100k_histos_{platform}",
+        "value": round(p99, 3),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / p99, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,191 @@
+"""Cluster-tier tests: wire roundtrips, two in-process Servers over real
+loopback gRPC (the reference's server_test.go/importsrv strategy), the
+consistent ring, and the proxy fan-out."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.cluster import wire
+from veneur_tpu.cluster.discovery import StaticDiscoverer
+from veneur_tpu.cluster.forward import GrpcForwarder
+from veneur_tpu.cluster.protos import forward_pb2, metric_pb2
+from veneur_tpu.cluster.proxy import ConsistentRing, ProxyServer
+from veneur_tpu.config import read_config
+from veneur_tpu.ingest.parser import MetricKey
+from veneur_tpu.models.pipeline import ForwardExport
+from veneur_tpu.server import Server
+from veneur_tpu.sinks.basic import CaptureMetricSink
+
+
+def test_wire_roundtrip():
+    exp = ForwardExport()
+    key = MetricKey("api.lat", "timer", "env:prod,svc:web")
+    exp.histograms.append((key, np.array([1.0, 5.0], np.float32),
+                           np.array([3.0, 2.0], np.float32),
+                           1.0, 5.0, 13.0, 5.0, 3.4))
+    exp.sets.append((MetricKey("users", "set", ""),
+                     np.arange(1 << 14, dtype=np.uint8) % 16))
+    exp.counters.append((MetricKey("hits", "counter", ""), 42.0))
+    exp.gauges.append((MetricKey("temp", "gauge", ""), 98.6))
+    pbs = wire.export_to_metrics(exp)
+    data = forward_pb2.MetricList(
+        metrics=pbs).SerializeToString()
+    back = forward_pb2.MetricList.FromString(data)
+    assert len(back.metrics) == 4
+    h = back.metrics[0]
+    assert h.name == "api.lat"
+    assert wire.metric_key_of(h) == key
+    assert len(h.histogram.t_digest.centroids) == 2
+    assert h.histogram.t_digest.count == 5.0
+    s = back.metrics[1]
+    regs = wire.decode_hll(s.set.hyper_log_log)
+    assert len(regs) == 1 << 14 and regs[17] == 17 % 16
+    assert back.metrics[2].counter.value == 42
+    assert back.metrics[3].gauge.value == pytest.approx(98.6)
+
+
+def _mk_server(extra, sink=None):
+    text = """
+interval: "1s"
+num_workers: 2
+percentiles: [0.5, 0.99]
+aggregates: ["min", "max", "count"]
+hostname: h
+tpu_histogram_slots: 512
+tpu_counter_slots: 512
+tpu_gauge_slots: 512
+tpu_set_slots: 256
+tpu_batch_size: 256
+tpu_buffer_depth: 128
+"""
+    cfg = read_config(text=text)
+    for k, v in extra.items():
+        setattr(cfg, k, v)
+    sink = sink or CaptureMetricSink()
+    return Server(cfg, sinks=[sink]), sink
+
+
+def test_two_servers_grpc_forward():
+    """local Server --forwardrpc--> global Server, real loopback gRPC."""
+    glob, gsink = _mk_server({"grpc_listen_addresses": ["127.0.0.1:0"]})
+    glob.start()
+    try:
+        gport = glob.grpc_port
+        local, lsink = _mk_server({
+            "forward_address": f"127.0.0.1:{gport}",
+            "statsd_listen_addresses": ["udp://127.0.0.1:0"]})
+        local.start()
+        try:
+            port = local.bound_port()
+            c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            rng = np.random.default_rng(2)
+            vals = rng.normal(100, 10, 500)
+            for v in vals:
+                c.sendto(b"fw.lat:%.4f|ms" % v, ("127.0.0.1", port))
+            c.sendto(b"fw.uniq:a|s\nfw.uniq:b|s\nfw.uniq:c|s",
+                     ("127.0.0.1", port))
+            c.sendto(b"fw.total:9|c|#veneurglobalonly", ("127.0.0.1", port))
+
+            # wait until the GLOBAL tier has seen all 500 samples
+            # (they may straddle local flush intervals — counts are summed
+            # across global flushes)
+            deadline = time.time() + 25
+            names = {}
+
+            def count_sum():
+                return sum(m.value for m in gsink.all_metrics
+                           if m.name == "fw.lat.count")
+
+            while time.time() < deadline:
+                names = {m.name: m for m in gsink.all_metrics}
+                if count_sum() >= 500 and "fw.uniq" in names \
+                        and "fw.total" in names:
+                    break
+                time.sleep(0.3)
+            assert "fw.lat.50percentile" in names, names.keys()
+            assert names["fw.lat.50percentile"].value == pytest.approx(
+                np.median(vals), abs=3.0)
+            assert count_sum() == 500.0
+            assert names["fw.uniq"].value == pytest.approx(3, abs=0.5)
+            assert sum(m.value for m in gsink.all_metrics
+                       if m.name == "fw.total") == 9.0
+            # local tier emitted aggregates but no percentiles for mixed
+            lnames = {m.name for m in lsink.all_metrics}
+            assert "fw.lat.count" in lnames
+            assert "fw.lat.50percentile" not in lnames
+        finally:
+            local.stop()
+    finally:
+        glob.stop()
+
+
+def test_ring_distribution_and_stability():
+    ring = ConsistentRing(["a:1", "b:1", "c:1"])
+    keys = [f"metric-{i}".encode() for i in range(3000)]
+    before = {k: ring.get(k) for k in keys}
+    counts = {}
+    for d in before.values():
+        counts[d] = counts.get(d, 0) + 1
+    assert len(counts) == 3
+    assert min(counts.values()) > 500  # roughly balanced
+    # removing one destination must only remap its own keys
+    ring.set_destinations(["a:1", "b:1"])
+    moved = sum(1 for k in keys
+                if before[k] != "c:1" and ring.get(k) != before[k])
+    assert moved == 0
+
+
+class _CaptureForwarder:
+    instances: dict = {}
+
+    def __init__(self, dest):
+        self.dest = dest
+        self.got = []
+        _CaptureForwarder.instances[dest] = self
+
+    def send_metrics(self, metrics):
+        self.got.extend(metrics)
+
+
+def test_proxy_routes_by_key():
+    _CaptureForwarder.instances = {}
+    proxy = ProxyServer(StaticDiscoverer(["g1:1", "g2:1", "g3:1"]),
+                        forwarder_factory=_CaptureForwarder)
+    metrics = []
+    for i in range(300):
+        m = metric_pb2.Metric(name=f"m{i}", type=metric_pb2.Counter)
+        m.counter.value = i
+        metrics.append(m)
+    errs = proxy.handle_metric_list(forward_pb2.MetricList(metrics=metrics))
+    assert not errs
+    total = sum(len(f.got) for f in _CaptureForwarder.instances.values())
+    assert total == 300
+    assert len(_CaptureForwarder.instances) == 3
+    # same key always lands on the same destination
+    groups1 = proxy.route_metrics(metrics)
+    groups2 = proxy.route_metrics(metrics)
+    assert {d: [m.name for m in ms] for d, ms in groups1.items()} == \
+        {d: [m.name for m in ms] for d, ms in groups2.items()}
+
+
+def test_proxy_grpc_end_to_end():
+    """client -> proxy gRPC -> (captured) destinations."""
+    _CaptureForwarder.instances = {}
+    proxy = ProxyServer(StaticDiscoverer(["d1:1", "d2:1"]),
+                        forwarder_factory=_CaptureForwarder)
+    server, port = proxy.start("127.0.0.1:0")
+    try:
+        fw = GrpcForwarder(f"127.0.0.1:{port}")
+        exp = ForwardExport()
+        for i in range(20):
+            exp.counters.append(
+                (MetricKey(f"c{i}", "counter", ""), float(i)))
+        fw(exp)
+        total = sum(len(f.got) for f in _CaptureForwarder.instances.values())
+        assert total == 20
+        assert len(_CaptureForwarder.instances) == 2
+    finally:
+        proxy.stop()

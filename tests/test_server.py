@@ -1,0 +1,151 @@
+"""End-to-end server tests: a real Server on loopback UDP with a capturing
+fake sink (the server_test.go strategy), plus config parsing."""
+
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.config import read_config
+from veneur_tpu.server import Server
+from veneur_tpu.sinks.basic import CaptureMetricSink, LocalFilePlugin
+
+
+def make_server(tmp_yaml=None, **overrides):
+    text = """
+interval: "1s"
+statsd_listen_addresses: ["udp://127.0.0.1:0"]
+num_workers: 2
+num_readers: 1
+percentiles: [0.5]
+aggregates: ["min", "max", "count"]
+hostname: testhost
+tpu_histogram_slots: 512
+tpu_counter_slots: 512
+tpu_gauge_slots: 512
+tpu_set_slots: 256
+tpu_batch_size: 512
+tpu_buffer_depth: 128
+"""
+    cfg = read_config(text=text)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    sink = CaptureMetricSink()
+    srv = Server(cfg, sinks=[sink])
+    return srv, sink
+
+
+def test_config_parsing_veneur_keys():
+    cfg = read_config(text="""
+interval: "10s"
+statsd_listen_addresses:
+  - udp://127.0.0.1:8126
+forward_address: "veneur-global:3118"
+percentiles: [0.5, 0.99]
+datadog_api_key: abc
+unknown_key_is_ignored: true
+""")
+    assert cfg.interval_seconds == 10.0
+    assert cfg.forward_address == "veneur-global:3118"
+    assert cfg.percentiles == [0.5, 0.99]
+
+
+def test_config_env_override():
+    cfg = read_config(text="interval: '10s'",
+                      env={"VENEUR_INTERVAL": "500ms",
+                           "VENEUR_NUM_WORKERS": "4",
+                           "VENEUR_DEBUG": "true"})
+    assert cfg.interval_seconds == 0.5
+    assert cfg.num_workers == 4
+    assert cfg.debug is True
+
+
+def test_udp_end_to_end():
+    srv, sink = make_server()
+    srv.start()
+    try:
+        port = srv.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # several datagrams, incl. a multi-line one and a bad line
+        for i in range(100):
+            c.sendto(b"e2e.timer:%d|ms" % i, ("127.0.0.1", port))
+        c.sendto(b"e2e.count:5|c\ne2e.count:3|c\nbadline", ("127.0.0.1", port))
+        c.sendto(b"e2e.gauge:42|g", ("127.0.0.1", port))
+
+        assert sink.wait_for_flush(1, timeout=15)
+        # allow one more flush in case packets landed after the first tick
+        if not any(m.name == "e2e.count" for m in sink.all_metrics):
+            assert sink.wait_for_flush(len(sink.flushes) + 1, timeout=15)
+        got = {m.name: m for m in sink.all_metrics}
+        assert got["e2e.count"].value == 8.0
+        assert got["e2e.gauge"].value == 42.0
+        assert got["e2e.timer.count"].value == 100.0
+        assert got["e2e.timer.min"].value == 0.0
+        assert got["e2e.timer.max"].value == 99.0
+        assert got["e2e.timer.min"].hostname == "testhost"
+        # self-telemetry flows through the same pipe
+        assert "veneur.packet.received_total" in got
+        assert got["veneur.packet.error_total"].value >= 1.0
+    finally:
+        srv.stop()
+
+
+def test_flush_interval_resets_and_continues():
+    srv, sink = make_server()
+    srv.start()
+    try:
+        port = srv.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c.sendto(b"tick:1|c", ("127.0.0.1", port))
+        assert sink.wait_for_flush(2, timeout=20)
+        vals = [m.value for fl in sink.flushes for m in fl
+                if m.name == "tick"]
+        assert vals == [1.0]  # reported once, not re-reported as 0
+    finally:
+        srv.stop()
+
+
+def test_localfile_plugin(tmp_path):
+    out = tmp_path / "metrics.tsv"
+    srv, sink = make_server()
+    srv.plugins = [LocalFilePlugin(str(out), 1)]
+    srv.start()
+    try:
+        port = srv.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c.sendto(b"file.metric:7|c|#k:v", ("127.0.0.1", port))
+        assert sink.wait_for_flush(1, timeout=15)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if out.exists() and "file.metric" in out.read_text():
+                break
+            time.sleep(0.2)
+        text = out.read_text()
+        assert "file.metric\tk:v\tcounter\ttesthost" in text
+    finally:
+        srv.stop()
+
+
+def test_forwarder_receives_exports():
+    exports = []
+    srv, sink = make_server(forward_address="fake:3118")
+    srv.forwarder = exports.append
+    srv.start()
+    try:
+        port = srv.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for i in range(10):
+            c.sendto(b"fwd.hist:%d|ms" % i, ("127.0.0.1", port))
+        assert sink.wait_for_flush(1, timeout=15)
+        deadline = time.time() + 10
+        while not exports and time.time() < deadline:
+            time.sleep(0.2)
+        assert exports, "forwarder never called"
+        assert any(k.name == "fwd.hist"
+                   for k, *_ in exports[0].histograms)
+        # mixed histo under forwarding: local aggregates still emitted
+        names = {m.name for m in sink.all_metrics}
+        assert "fwd.hist.count" in names
+        assert "fwd.hist.50percentile" not in names
+    finally:
+        srv.stop()

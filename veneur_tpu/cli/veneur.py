@@ -1,0 +1,54 @@
+"""The main daemon CLI — `python -m veneur_tpu.cli.veneur -f config.yaml`.
+
+Parity: cmd/veneur/main.go (sym: main): read config, build server, run
+until signalled.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="veneur-tpu")
+    ap.add_argument("-f", dest="config", required=True,
+                    help="path to YAML config")
+    ap.add_argument("--validate-config", action="store_true",
+                    help="parse config and exit")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if "-v" in (argv or sys.argv) else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    from ..config import read_config
+    cfg = read_config(args.config)
+    if args.validate_config:
+        print("config ok")
+        return 0
+
+    if cfg.aggregation_backend == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from ..server import Server
+    srv = Server(cfg)
+    srv.start()
+    logging.getLogger("veneur").info(
+        "veneur-tpu serving: statsd=%s interval=%ss workers=%d",
+        cfg.statsd_listen_addresses, cfg.interval_seconds, cfg.num_workers)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""Cluster tier: forwarding, import server, proxy, discovery.
+
+Parity map (SURVEY §2.3):
+  wire.py      <-> samplers .Metric()/.Export()/.Combine() conversions
+  forward.py   <-> flusher.go's forwardGRPC / flushForward (client side)
+  importsrv.py <-> importsrv/server.go (global veneur gRPC receive)
+  proxy.py     <-> proxysrv/server.go + proxy.go (consistent-hash fanout)
+  discovery.py <-> discovery.go / consul.go (Discoverer interface)
+"""

@@ -1,0 +1,52 @@
+"""Service discovery for the global tier.
+
+Parity: discovery.go (sym: Discoverer interface —
+GetDestinationsForService), consul.go (sym: Consul health-endpoint
+implementation), plus the static-list fallback veneur supports via
+config. The proxy refreshes its ring from a Discoverer on a ticker
+(proxy.go sym: Proxy.RefreshDestinations).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+from typing import Protocol
+
+log = logging.getLogger("veneur_tpu.cluster.discovery")
+
+
+class Discoverer(Protocol):
+    def get_destinations_for_service(self, service: str) -> list[str]: ...
+
+
+class StaticDiscoverer:
+    def __init__(self, destinations: list[str]):
+        self.destinations = list(destinations)
+
+    def get_destinations_for_service(self, service: str) -> list[str]:
+        return list(self.destinations)
+
+
+class ConsulDiscoverer:
+    """Query Consul's health API for passing instances
+    (GET /v1/health/service/<name>?passing)."""
+
+    def __init__(self, consul_url: str = "http://127.0.0.1:8500",
+                 timeout_s: float = 5.0):
+        self.base = consul_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def get_destinations_for_service(self, service: str) -> list[str]:
+        url = f"{self.base}/v1/health/service/{service}?passing"
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            entries = json.load(resp)
+        out = []
+        for e in entries:
+            svc = e.get("Service", {})
+            addr = svc.get("Address") or e.get("Node", {}).get("Address")
+            port = svc.get("Port")
+            if addr and port:
+                out.append(f"{addr}:{port}")
+        return out

@@ -1,0 +1,95 @@
+"""Forwarding clients: local veneur -> (proxy ->) global veneur.
+
+Parity: flusher.go (sym: Server.forwardGRPC) for the gRPC path and the
+legacy HTTP POST /import path (sym: Server.flushForward) — here JSON
+instead of Go gob, same payload semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+
+import grpc
+
+from ..models.pipeline import ForwardExport
+from . import wire
+from .protos import forward_pb2
+
+log = logging.getLogger("veneur_tpu.cluster.forward")
+
+SEND_METRICS = "/forwardrpc.Forward/SendMetrics"
+SEND_METRICS_V2 = "/forwardrpc.Forward/SendMetricsV2"
+
+
+class GrpcForwarder:
+    """Callable handed to Server.forwarder: ships a flush's exports
+    upstream over the forwardrpc contract."""
+
+    def __init__(self, address: str, timeout_s: float = 10.0,
+                 max_per_batch: int = 10_000):
+        self.address = address
+        self.timeout_s = timeout_s
+        self.max_per_batch = max_per_batch
+        self._channel = grpc.insecure_channel(address)
+        self._send = self._channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=forward_pb2.MetricList.SerializeToString,
+            response_deserializer=forward_pb2.Empty.FromString)
+
+    def __call__(self, export: ForwardExport):
+        self.send_metrics(wire.export_to_metrics(export))
+
+    def send_metrics(self, metrics: list):
+        """Ship raw metricpb.Metrics (used by the proxy's re-batching)."""
+        for i in range(0, len(metrics), self.max_per_batch):
+            self._send(
+                forward_pb2.MetricList(
+                    metrics=metrics[i:i + self.max_per_batch]),
+                timeout=self.timeout_s)
+
+    def close(self):
+        self._channel.close()
+
+
+class HttpJsonForwarder:
+    """Legacy-path forwarder: POST /import with a JSON array (the
+    reference's JSONMetric list; digests ride as centroid arrays rather
+    than Go gob blobs)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0):
+        self.url = base_url.rstrip("/") + "/import"
+        self.timeout_s = timeout_s
+
+    def __call__(self, export: ForwardExport):
+        body = []
+        for key, means, weights, vmin, vmax, vsum, cnt, recip in (
+                export.histograms):
+            body.append({
+                "name": key.name, "type": key.type,
+                "tags": wire._split_tags(key.joined_tags),
+                "histogram": {
+                    "centroids": [[float(m), float(w)]
+                                  for m, w in zip(means, weights)],
+                    "min": float(vmin), "max": float(vmax),
+                    "sum": float(vsum), "count": float(cnt),
+                    "reciprocal_sum": float(recip)}})
+        for key, regs in export.sets:
+            body.append({"name": key.name, "type": "set",
+                         "tags": wire._split_tags(key.joined_tags),
+                         "set": wire.encode_hll(regs).hex()})
+        for key, value in export.counters:
+            body.append({"name": key.name, "type": "counter",
+                         "tags": wire._split_tags(key.joined_tags),
+                         "value": value})
+        for key, value in export.gauges:
+            body.append({"name": key.name, "type": "gauge",
+                         "tags": wire._split_tags(key.joined_tags),
+                         "value": value})
+        req = urllib.request.Request(
+            self.url, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            if resp.status >= 400:
+                raise RuntimeError(f"forward POST: HTTP {resp.status}")

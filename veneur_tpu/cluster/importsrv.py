@@ -1,0 +1,81 @@
+"""The global tier's gRPC receive path.
+
+Parity: importsrv/server.go (sym: importsrv.Server.SendMetrics,
+MetricIngester): implements forwardrpc.Forward, re-hashes each received
+metric by its key digest onto a worker, whose engine merges it via the
+Combine kernels (engine.import_*).
+
+Wired with grpc's generic handler API (no grpcio-tools codegen needed):
+method names + message serializers define the service.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+
+import grpc
+
+from ..utils.hashing import metric_digest
+from . import wire
+from .protos import forward_pb2
+
+log = logging.getLogger("veneur_tpu.cluster.importsrv")
+
+
+class ImportedMetric:
+    """Worker-queue envelope for a forwarded metricpb.Metric."""
+
+    __slots__ = ("pb",)
+
+    def __init__(self, pb):
+        self.pb = pb
+
+
+class ForwardHandler(grpc.GenericRpcHandler):
+    """grpc.GenericRpcHandler serving forwardrpc.Forward."""
+
+    def __init__(self, submit):
+        """`submit(worker_index_hash, ImportedMetric)` routes one metric;
+        the Server provides a queue-backed implementation."""
+        self._submit = submit
+
+    def service(self, details):
+        from .forward import SEND_METRICS, SEND_METRICS_V2
+        if details.method == SEND_METRICS:
+            return grpc.unary_unary_rpc_method_handler(
+                self._send_metrics,
+                request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=forward_pb2.Empty.SerializeToString)
+        if details.method == SEND_METRICS_V2:
+            return grpc.stream_unary_rpc_method_handler(
+                self._send_metrics_v2,
+                request_deserializer=wire.metric_pb2.Metric.FromString,
+                response_serializer=forward_pb2.Empty.SerializeToString)
+        return None
+
+    def _route(self, m):
+        key = wire.metric_key_of(m)
+        digest = metric_digest(key.name, key.type, key.joined_tags)
+        self._submit(digest, ImportedMetric(m))
+
+    def _send_metrics(self, request, context):
+        for m in request.metrics:
+            self._route(m)
+        return forward_pb2.Empty()
+
+    def _send_metrics_v2(self, request_iterator, context):
+        for m in request_iterator:
+            self._route(m)
+        return forward_pb2.Empty()
+
+
+def start_import_server(address: str, submit, max_workers: int = 8):
+    """Bind a gRPC server for the Forward service; returns (server, port)."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((ForwardHandler(submit),))
+    port = server.add_insecure_port(address)
+    server.start()
+    log.info("importsrv listening on %s", address)
+    return server, port

@@ -1,0 +1,17 @@
+"""Generated protobuf modules (protoc --python_out).
+
+forward_pb2 does a top-level `import metric_pb2`, so the package dir goes
+onto sys.path before loading it.
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(__file__)
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+import forward_pb2  # noqa: E402
+import metric_pb2  # noqa: E402
+
+__all__ = ["metric_pb2", "forward_pb2"]

@@ -1,0 +1,186 @@
+"""veneur-proxy: consistent-hash fan-out of forwarded metrics across a
+pool of global veneurs.
+
+Parity: proxysrv/server.go (sym: proxysrv.Server.SendMetrics — gRPC in,
+per-destination re-batch, gRPC out) and proxy.go (sym: Proxy.ProxyMetrics,
+Proxy.RefreshDestinations — ring refresh from a Discoverer). The ring uses
+the replicated-point construction of the reference's vendored
+stathat/consistent library (N virtual points per destination, keys walk
+clockwise to the first point), with fnv1a-32 as the point hash.
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import threading
+
+from ..utils.hashing import fnv1a_32
+from . import wire
+from .forward import GrpcForwarder
+from .protos import forward_pb2
+
+log = logging.getLogger("veneur_tpu.cluster.proxy")
+
+
+class ConsistentRing:
+    """Consistent-hash ring with virtual replicas."""
+
+    def __init__(self, destinations: list[str] | None = None,
+                 replicas: int = 120):
+        self.replicas = replicas
+        self._points: list[int] = []
+        self._owners: dict[int, str] = {}
+        if destinations:
+            self.set_destinations(destinations)
+
+    def set_destinations(self, destinations: list[str]):
+        points: list[int] = []
+        owners: dict[int, str] = {}
+        for d in destinations:
+            for i in range(self.replicas):
+                h = fnv1a_32(f"{d}#{i}".encode())
+                owners[h] = d
+                points.append(h)
+        points.sort()
+        self._points, self._owners = points, owners
+
+    def get(self, key: bytes) -> str:
+        if not self._points:
+            raise RuntimeError("ring is empty")
+        h = fnv1a_32(key)
+        i = bisect.bisect_right(self._points, h)
+        if i == len(self._points):
+            i = 0
+        return self._owners[self._points[i]]
+
+    def __len__(self):
+        return len(set(self._owners.values()))
+
+
+class ProxyServer:
+    """Receives forwardrpc batches, splits per metric, consistent-hashes
+    each metric key onto a destination, re-batches and forwards."""
+
+    def __init__(self, discoverer, service_name: str = "",
+                 refresh_interval_s: float = 30.0, replicas: int = 120,
+                 forwarder_factory=GrpcForwarder):
+        self.discoverer = discoverer
+        self.service_name = service_name
+        self.refresh_interval_s = refresh_interval_s
+        self.ring = ConsistentRing(replicas=replicas)
+        self._forwarders: dict[str, object] = {}
+        self._factory = forwarder_factory
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._grpc_server = None
+        self.refresh_destinations()
+
+    # ---- ring maintenance ----
+
+    def refresh_destinations(self):
+        try:
+            dests = self.discoverer.get_destinations_for_service(
+                self.service_name)
+        except Exception:
+            log.exception("destination refresh failed; keeping old ring")
+            return
+        if not dests:
+            log.warning("discoverer returned no destinations; keeping ring")
+            return
+        with self._lock:
+            self.ring.set_destinations(dests)
+            for d in list(self._forwarders):
+                if d not in dests:
+                    fw = self._forwarders.pop(d)
+                    close = getattr(fw, "close", None)
+                    if close:
+                        try:
+                            close()
+                        except Exception:
+                            pass
+
+    def _refresh_loop(self):
+        while not self._stop.wait(self.refresh_interval_s):
+            self.refresh_destinations()
+
+    # ---- routing ----
+
+    def _forwarder_for(self, dest: str):
+        with self._lock:
+            fw = self._forwarders.get(dest)
+            if fw is None:
+                fw = self._factory(dest)
+                self._forwarders[dest] = fw
+        return fw
+
+    def route_metrics(self, metrics) -> dict[str, list]:
+        """Group metricpb.Metrics by owning destination."""
+        groups: dict[str, list] = {}
+        for m in metrics:
+            key = wire.metric_key_of(m)
+            ring_key = f"{key.name}{key.type}{key.joined_tags}".encode()
+            with self._lock:
+                dest = self.ring.get(ring_key)
+            groups.setdefault(dest, []).append(m)
+        return groups
+
+    def handle_metric_list(self, metric_list):
+        """The SendMetrics implementation: fan out groups concurrently
+        (one goroutine per destination in the reference)."""
+        groups = self.route_metrics(metric_list.metrics)
+        errs: list[Exception] = []
+        threads = []
+        for dest, ms in groups.items():
+            def send(dest=dest, ms=ms):
+                try:
+                    self._forwarder_for(dest).send_metrics(ms)
+                except Exception as e:
+                    log.warning("proxy forward to %s failed: %s", dest, e)
+                    errs.append(e)
+            t = threading.Thread(target=send, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        return errs
+
+    # ---- gRPC front ----
+
+    def start(self, address: str):
+        import grpc
+
+        # The proxy serves the same Forward contract, forwarding whole
+        # batches without aggregating.
+        class _BatchHandler(grpc.GenericRpcHandler):
+            def service(inner, details):
+                from .forward import SEND_METRICS
+                if details.method == SEND_METRICS:
+                    return grpc.unary_unary_rpc_method_handler(
+                        lambda req, ctx: self._serve_batch(req),
+                        request_deserializer=(
+                            forward_pb2.MetricList.FromString),
+                        response_serializer=(
+                            forward_pb2.Empty.SerializeToString))
+                return None
+
+        from concurrent import futures
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        server.add_generic_rpc_handlers((_BatchHandler(),))
+        port = server.add_insecure_port(address)
+        server.start()
+        self._grpc_server = server
+        t = threading.Thread(target=self._refresh_loop, daemon=True,
+                             name="proxy-refresh")
+        t.start()
+        log.info("proxy listening on %s", address)
+        return server, port
+
+    def _serve_batch(self, request):
+        self.handle_metric_list(request)
+        return forward_pb2.Empty()
+
+    def stop(self):
+        self._stop.set()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(1.0)

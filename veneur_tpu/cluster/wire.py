@@ -1,0 +1,110 @@
+"""Conversions between engine exports and the metricpb wire format.
+
+Parity: the samplers' Metric()/Export() (local side, producing
+metricpb.Metric) and Combine() (global side, consuming it) —
+samplers/samplers.go, worker.go (sym: Worker.ImportMetricGRPC).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ingest.parser import (GLOBAL_ONLY, LOCAL_ONLY, MIXED_SCOPE,
+                             MetricKey)
+from ..models.pipeline import ForwardExport
+from .protos import metric_pb2
+
+HLL_VERSION = 1
+
+_TYPE_TO_PB = {
+    "counter": metric_pb2.Counter,
+    "gauge": metric_pb2.Gauge,
+    "histogram": metric_pb2.Histogram,
+    "timer": metric_pb2.Timer,
+    "set": metric_pb2.Set,
+}
+_PB_TO_TYPE = {v: k for k, v in _TYPE_TO_PB.items()}
+_PB_TO_TYPE[metric_pb2.Timer] = "timer"
+
+
+def encode_hll(registers: np.ndarray) -> bytes:
+    regs = np.asarray(registers, np.uint8)
+    precision = int(np.log2(len(regs)))
+    return bytes([HLL_VERSION, precision]) + regs.tobytes()
+
+
+def decode_hll(data: bytes) -> np.ndarray:
+    if len(data) < 2 or data[0] != HLL_VERSION:
+        raise ValueError("bad HLL payload")
+    precision = data[1]
+    regs = np.frombuffer(data[2:], np.uint8)
+    if len(regs) != 1 << precision:
+        raise ValueError("HLL register count mismatch")
+    return regs
+
+
+def export_to_metrics(export: ForwardExport) -> list:
+    """ForwardExport -> [metricpb.Metric] (the flush-side serialization)."""
+    out = []
+    for key, means, weights, vmin, vmax, vsum, count, recip in (
+            export.histograms):
+        m = metric_pb2.Metric(
+            name=key.name, tags=_split_tags(key.joined_tags),
+            type=_TYPE_TO_PB.get(key.type, metric_pb2.Histogram),
+            scope=metric_pb2.Global)
+        td = m.histogram.t_digest
+        td.min, td.max, td.sum = float(vmin), float(vmax), float(vsum)
+        td.count, td.reciprocal_sum = float(count), float(recip)
+        for mean, w in zip(np.asarray(means), np.asarray(weights)):
+            if w > 0:
+                td.centroids.add(mean=float(mean), weight=float(w))
+        out.append(m)
+    for key, regs in export.sets:
+        m = metric_pb2.Metric(name=key.name,
+                              tags=_split_tags(key.joined_tags),
+                              type=metric_pb2.Set, scope=metric_pb2.Global)
+        m.set.hyper_log_log = encode_hll(regs)
+        out.append(m)
+    for key, value in export.counters:
+        m = metric_pb2.Metric(name=key.name,
+                              tags=_split_tags(key.joined_tags),
+                              type=metric_pb2.Counter,
+                              scope=metric_pb2.Global)
+        m.counter.value = int(round(value))
+        out.append(m)
+    for key, value in export.gauges:
+        m = metric_pb2.Metric(name=key.name,
+                              tags=_split_tags(key.joined_tags),
+                              type=metric_pb2.Gauge,
+                              scope=metric_pb2.Global)
+        m.gauge.value = float(value)
+        out.append(m)
+    return out
+
+
+def metric_key_of(m) -> MetricKey:
+    mtype = _PB_TO_TYPE.get(m.type, "histogram")
+    return MetricKey(name=m.name, type=mtype,
+                     joined_tags=",".join(sorted(m.tags)))
+
+
+def apply_metric_to_engine(engine, m) -> None:
+    """metricpb.Metric -> engine.import_* (the Combine dispatch)."""
+    key = metric_key_of(m)
+    which = m.WhichOneof("value")
+    if which == "histogram":
+        td = m.histogram.t_digest
+        means = np.array([c.mean for c in td.centroids], np.float32)
+        weights = np.array([c.weight for c in td.centroids], np.float32)
+        engine.import_histogram(key, means, weights, td.min, td.max,
+                                td.sum, td.count, td.reciprocal_sum)
+    elif which == "set":
+        engine.import_set(key, decode_hll(m.set.hyper_log_log))
+    elif which == "counter":
+        engine.import_counter(key, float(m.counter.value))
+    elif which == "gauge":
+        engine.import_gauge(key, m.gauge.value)
+
+
+def _split_tags(joined: str) -> list[str]:
+    return joined.split(",") if joined else []

@@ -1,0 +1,176 @@
+"""YAML configuration, keeping veneur's flat key names.
+
+Parity: config.go (sym: Config), config_parse.go (sym: ReadConfig —
+YAML file + env-var overrides), example.yaml. A veneur operator's YAML
+should drop in: the keys below are the reference's names; unknown keys
+warn rather than error (veneur ignores them), and `VENEUR_`-prefixed
+environment variables override file values like envconfig does.
+
+New keys for the TPU engine (the north star's `aggregation_backend: tpu`)
+are grouped at the bottom of the dataclass.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field, fields
+
+import yaml
+
+log = logging.getLogger("veneur_tpu.config")
+
+
+def _parse_interval(v) -> float:
+    """veneur durations are Go-style strings ("10s", "500ms") or numbers
+    of seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+    for suffix in ("ms", "s", "m", "h"):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * units[suffix]
+    return float(s)
+
+
+@dataclass
+class Config:
+    # --- core (config.go names) ---
+    interval: str = "10s"
+    hostname: str = ""
+    omit_empty_hostname: bool = False
+    tags: list = field(default_factory=list)
+    tags_exclude: list = field(default_factory=list)
+    percentiles: list = field(default_factory=lambda: [0.5, 0.75, 0.99])
+    aggregates: list = field(default_factory=lambda: ["min", "max", "count"])
+    num_workers: int = 1          # engine shards (device axis on TPU)
+    num_readers: int = 1          # UDP reader sockets (SO_REUSEPORT)
+    metric_max_length: int = 4096
+    read_buffer_size_bytes: int = 1 << 21  # SO_RCVBUF per UDP socket
+    trace_max_length_bytes: int = 16384
+    flush_max_per_body: int = 25000
+    synchronize_with_interval: bool = False
+    statsd_listen_addresses: list = field(default_factory=list)
+    ssf_listen_addresses: list = field(default_factory=list)
+    grpc_listen_addresses: list = field(default_factory=list)
+    http_address: str = ""
+    debug: bool = False
+    enable_profiling: bool = False
+    mutex_profile_fraction: int = 0
+    block_profile_rate: int = 0
+    sentry_dsn: str = ""
+    stats_address: str = ""
+
+    # --- forwarding / cluster ---
+    forward_address: str = ""
+    forward_use_grpc: bool = True
+    consul_forward_service_name: str = ""
+    consul_refresh_interval: str = "30s"
+
+    # --- TLS (statsd/SSF stream listeners) ---
+    tls_key: str = ""
+    tls_certificate: str = ""
+    tls_authority_certificate: str = ""
+
+    # --- watchdog / lifecycle ---
+    flush_watchdog_missed_flushes: int = 0
+
+    # --- sinks ---
+    datadog_api_key: str = ""
+    datadog_api_hostname: str = "https://app.datadoghq.com"
+    datadog_flush_max_per_body: int = 25000
+    signalfx_api_key: str = ""
+    signalfx_endpoint_base: str = "https://ingest.signalfx.com"
+    signalfx_vary_key_by: str = ""
+    kafka_broker: str = ""
+    kafka_topic: str = ""
+    kafka_metric_topic: str = ""
+    kafka_span_topic: str = ""
+    splunk_hec_address: str = ""
+    splunk_hec_token: str = ""
+    newrelic_account_id: int = 0
+    newrelic_insert_key: str = ""
+    lightstep_access_token: str = ""
+    xray_address: str = ""
+    falconer_address: str = ""
+    prometheus_repeater_address: str = ""
+    flush_file: str = ""          # localfile plugin target
+    aws_s3_bucket: str = ""
+    aws_region: str = ""
+    aws_access_key_id: str = ""
+    aws_secret_access_key: str = ""
+
+    # --- TPU engine (new; the north star's aggregation_backend key) ---
+    aggregation_backend: str = "tpu"   # "tpu" | "cpu" (forces jax cpu)
+    tpu_histogram_slots: int = 1 << 15
+    tpu_counter_slots: int = 1 << 14
+    tpu_gauge_slots: int = 1 << 14
+    tpu_set_slots: int = 1 << 12
+    tpu_batch_size: int = 8192
+    tpu_buffer_depth: int = 256
+    tpu_compression: float = 100.0
+    tpu_hll_precision: int = 14
+    tpu_slot_idle_ttl_intervals: int = 16
+    tpu_num_devices: int = 0           # 0 = all visible devices
+
+    # populated by the loader, not a YAML key:
+    is_global: bool = False
+
+    @property
+    def interval_seconds(self) -> float:
+        return _parse_interval(self.interval)
+
+    @property
+    def consul_refresh_seconds(self) -> float:
+        return _parse_interval(self.consul_refresh_interval)
+
+
+_FIELDS = {f.name: f for f in fields(Config)}
+
+
+def read_config(path: str | None = None, text: str | None = None,
+                env: dict | None = None) -> Config:
+    """ReadConfig: YAML file -> Config, with VENEUR_<UPPER_KEY> env
+    overrides (the envconfig behavior)."""
+    raw = {}
+    if text is not None:
+        raw = yaml.safe_load(text) or {}
+    elif path is not None:
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+
+    cfg = Config()
+    for k, v in raw.items():
+        if k in _FIELDS:
+            setattr(cfg, k, _coerce(k, v))
+        else:
+            log.warning("unknown config key %r ignored", k)
+
+    env = os.environ if env is None else env
+    for name in _FIELDS:
+        ev = env.get("VENEUR_" + name.upper())
+        if ev is not None:
+            setattr(cfg, name, _coerce(name, ev))
+    return cfg
+
+
+def _coerce(name: str, v):
+    f = _FIELDS[name]
+    t = f.type
+    if t == "bool" or isinstance(f.default, bool):
+        if isinstance(v, str):
+            return v.strip().lower() in ("1", "true", "yes", "on")
+        return bool(v)
+    if isinstance(f.default, int) and not isinstance(f.default, bool):
+        return int(v)
+    if isinstance(f.default, float):
+        return float(v)
+    if t == "list" or "list" in str(t):
+        if isinstance(v, str):
+            v = [s.strip() for s in v.split(",") if s.strip()]
+        v = list(v)
+        if name == "percentiles":  # float-element list keys
+            v = [float(x) for x in v]
+        return v
+    return v

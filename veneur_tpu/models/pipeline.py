@@ -25,6 +25,7 @@ Scope routing (flusher.go semantics):
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field as dc_field
 
@@ -110,6 +111,12 @@ class _Stage:
 class AggregationEngine:
     def __init__(self, config: EngineConfig | None = None):
         self.cfg = config or EngineConfig()
+        # One ingest thread owns process(); flush() may run from another
+        # thread. The lock is the Worker.Flush mutex-swap equivalent:
+        # ingest holds it per item; flush holds it ONLY across
+        # drain+swap+bookkeeping, then runs the merge program on the
+        # immutable snapshot lock-free while ingest continues.
+        self.lock = threading.Lock()
         cfg = self.cfg
         self.histo_bank = tdigest.init(
             cfg.histogram_slots, cfg.compression, cfg.buffer_depth)
@@ -162,7 +169,11 @@ class AggregationEngine:
 
     def process(self, m: UDPMetric):
         """Route one parsed sample to its bank's staging buffer — the
-        Worker.ProcessMetric equivalent."""
+        Worker.ProcessMetric equivalent. Thread-safe against flush()."""
+        with self.lock:
+            self._process_locked(m)
+
+    def _process_locked(self, m: UDPMetric):
         t = m.key.type
         self.samples_processed += 1
         if t in ("timer", "histogram"):
@@ -207,10 +218,12 @@ class AggregationEngine:
                 self._dispatch_sets()
 
     def process_event(self, ev):
-        self._pending_events.append(ev)
+        with self.lock:
+            self._pending_events.append(ev)
 
     def process_service_check(self, sc):
-        self._pending_checks.append(sc)
+        with self.lock:
+            self._pending_checks.append(sc)
 
     def _dispatch_histos(self):
         a = self._histo_stage.drain()
@@ -247,38 +260,43 @@ class AggregationEngine:
                          vsum, count, recip=0.0):
         """Stage a forwarded digest for merging — Histo.Combine
         (importsrv path, worker.go sym: Worker.ImportMetricGRPC)."""
-        slot = self.histo_keys.lookup(key, GLOBAL_ONLY)
-        if slot < 0:
-            return
-        self._import_centroids.append(
-            (slot, np.asarray(means, np.float32),
-             np.asarray(weights, np.float32),
-             float(vmin), float(vmax), float(vsum), float(count),
-             float(recip)))
-        if len(self._import_centroids) >= 512:
-            self._flush_import_centroids()
+        with self.lock:
+            slot = self.histo_keys.lookup(key, GLOBAL_ONLY)
+            if slot < 0:
+                return
+            self._import_centroids.append(
+                (slot, np.asarray(means, np.float32),
+                 np.asarray(weights, np.float32),
+                 float(vmin), float(vmax), float(vsum), float(count),
+                 float(recip)))
+            if len(self._import_centroids) >= 512:
+                self._flush_import_centroids()
 
     def import_set(self, key: MetricKey, registers):
-        slot = self.set_keys.lookup(key, GLOBAL_ONLY)
-        if slot < 0:
-            return
-        self._import_sets.append((slot, np.asarray(registers, np.uint8)))
-        if len(self._import_sets) >= 256:
-            self._flush_import_sets()
+        with self.lock:
+            slot = self.set_keys.lookup(key, GLOBAL_ONLY)
+            if slot < 0:
+                return
+            self._import_sets.append(
+                (slot, np.asarray(registers, np.uint8)))
+            if len(self._import_sets) >= 256:
+                self._flush_import_sets()
 
     def import_counter(self, key: MetricKey, value: float):
-        slot = self.counter_keys.lookup(key, GLOBAL_ONLY)
-        if slot < 0:
-            return
-        # Host-side f64 accumulation — exact, and one device call per flush.
-        self._import_counter_acc[slot] = (
-            self._import_counter_acc.get(slot, 0.0) + float(value))
+        with self.lock:
+            slot = self.counter_keys.lookup(key, GLOBAL_ONLY)
+            if slot < 0:
+                return
+            # Host-side f64 accumulation — exact, one device call per flush.
+            self._import_counter_acc[slot] = (
+                self._import_counter_acc.get(slot, 0.0) + float(value))
 
     def import_gauge(self, key: MetricKey, value: float):
-        slot = self.gauge_keys.lookup(key, GLOBAL_ONLY)
-        if slot < 0:
-            return
-        self._import_gauge_acc[slot] = float(value)  # last write wins
+        with self.lock:
+            slot = self.gauge_keys.lookup(key, GLOBAL_ONLY)
+            if slot < 0:
+                return
+            self._import_gauge_acc[slot] = float(value)  # last write wins
 
     def _flush_import_sets(self):
         if not self._import_sets:
@@ -367,23 +385,49 @@ class AggregationEngine:
 
     def flush(self, timestamp: int | None = None) -> FlushResult:
         """The Server.Flush equivalent: snapshot banks, run the merge
-        program, assemble InterMetrics + forward exports, reset state."""
+        program, assemble InterMetrics + forward exports, reset state.
+
+        Only the drain+swap phase holds the ingest lock (the Worker.Flush
+        mutex-swap); the merge program and host assembly run on the
+        immutable snapshot while ingest continues into fresh banks."""
         ts = int(timestamp if timestamp is not None else time.time())
         cfg = self.cfg
-        self.drain_all()
-        self._flush_import_centroids()
-        self._flush_import_sets()
-        self._flush_import_scalars()
+        with self.lock:
+            self.drain_all()
+            self._flush_import_centroids()
+            self._flush_import_sets()
+            self._flush_import_scalars()
 
-        # Snapshot current banks (immutable arrays) and hand ingest fresh
-        # ones — the Worker.Flush swap.
-        hb, cb, gb, sb = (self.histo_bank, self.counter_bank,
-                          self.gauge_bank, self.set_bank)
-        self.histo_bank = tdigest.reset(hb)
-        self.counter_bank = scalar.reset_counters(cb)
-        self.gauge_bank = scalar.reset_gauges(gb)
-        self.set_bank = hll.reset(sb)
-        self._gauge_seq = 0
+            # Snapshot current banks (immutable arrays) and hand ingest
+            # fresh ones — the Worker.Flush swap.
+            hb, cb, gb, sb = (self.histo_bank, self.counter_bank,
+                              self.gauge_bank, self.set_bank)
+            self.histo_bank = tdigest.reset(hb)
+            self.counter_bank = scalar.reset_counters(cb)
+            self.gauge_bank = scalar.reset_gauges(gb)
+            self.set_bank = hll.reset(sb)
+            self._gauge_seq = 0
+            active = {
+                "histo": [(k, s, self.histo_keys.scope_of(s))
+                          for k, s in self.histo_keys.active_items()],
+                "counter": [(k, s, self.counter_keys.scope_of(s))
+                            for k, s in self.counter_keys.active_items()],
+                "gauge": [(k, s, self.gauge_keys.scope_of(s))
+                          for k, s in self.gauge_keys.active_items()],
+                "set": [(k, s, self.set_keys.scope_of(s))
+                        for k, s in self.set_keys.active_items()],
+            }
+            stats_samples = self.samples_processed
+            self.samples_processed = 0
+            dropped = 0
+            for ki in (self.histo_keys, self.counter_keys,
+                       self.gauge_keys, self.set_keys):
+                dropped += ki.dropped_no_slot
+                ki.dropped_no_slot = 0  # per-interval, like `samples`
+            histo_key_count = len(self.histo_keys)
+            for ki in (self.histo_keys, self.counter_keys,
+                       self.gauge_keys, self.set_keys):
+                ki.advance_interval()
 
         hb = tdigest.compress(hb, compression=cfg.compression)
         device = {
@@ -410,8 +454,7 @@ class AggregationEngine:
                 tags=tags, type=mtype, hostname=cfg.hostname))
 
         agg = host["agg"]
-        for key, slot in self.histo_keys.active_items():
-            scope = self.histo_keys.scope_of(slot)
+        for key, slot, scope in active["histo"]:
             if float(agg["count"][slot]) <= 0:
                 continue
             forward_it = fwd and scope != LOCAL_ONLY
@@ -440,16 +483,14 @@ class AggregationEngine:
                           else MetricType.GAUGE)
                     emit(key, f".{name}", val, mt)
 
-        for key, slot in self.counter_keys.active_items():
-            scope = self.counter_keys.scope_of(slot)
+        for key, slot, scope in active["counter"]:
             total = float(host["c_hi"][slot]) + float(host["c_lo"][slot])
             if fwd and scope == GLOBAL_ONLY and not cfg.is_global:
                 export.counters.append((key, total))
             else:
                 emit(key, "", total, MetricType.COUNTER)
 
-        for key, slot in self.gauge_keys.active_items():
-            scope = self.gauge_keys.scope_of(slot)
+        for key, slot, scope in active["gauge"]:
             if host["g_seq"][slot] < 0:
                 continue
             val = float(host["g_value"][slot])
@@ -458,8 +499,7 @@ class AggregationEngine:
             else:
                 emit(key, "", val, MetricType.GAUGE)
 
-        for key, slot in self.set_keys.active_items():
-            scope = self.set_keys.scope_of(slot)
+        for key, slot, scope in active["set"]:
             forward_it = fwd and scope != LOCAL_ONLY and not cfg.is_global
             if forward_it:
                 export.sets.append((key, host["s_regs"][slot]))
@@ -467,21 +507,14 @@ class AggregationEngine:
                 emit(key, "", host["s_est"][slot], MetricType.GAUGE)
 
         stats = {
-            "samples": self.samples_processed,
-            "histo_keys": len(self.histo_keys),
-            "dropped_no_slot": (
-                self.histo_keys.dropped_no_slot
-                + self.counter_keys.dropped_no_slot
-                + self.gauge_keys.dropped_no_slot
-                + self.set_keys.dropped_no_slot),
+            "samples": stats_samples,
+            "histo_keys": histo_key_count,
+            "dropped_no_slot": dropped,
         }
-        self.samples_processed = 0
-        for ki in (self.histo_keys, self.counter_keys, self.gauge_keys,
-                   self.set_keys):
-            ki.advance_interval()
         return FlushResult(metrics=out, export=export, stats=stats)
 
     def drain_events(self):
-        evs, self._pending_events = self._pending_events, []
-        chks, self._pending_checks = self._pending_checks, []
+        with self.lock:
+            evs, self._pending_events = self._pending_events, []
+            chks, self._pending_checks = self._pending_checks, []
         return evs, chks

@@ -141,9 +141,13 @@ def _compress_impl(bank: TDigestBank, compression: float) -> TDigestBank:
         k_start = jnp.where(new, kl, k_start)
         return k_start, new
 
+    # Initial carry is derived from data (k_left[:,0] - 2 <= any k minus 1,
+    # so the first weighted element always opens a cluster) rather than a
+    # constant: inside shard_map a constant carry would lack the varying
+    # mesh-axes type and fail the scan type check.
     _, is_new = jax.lax.scan(
         step,
-        jnp.full((K,), -_INF, vals.dtype),
+        k_left[:, 0] - 2.0,
         (k_left.T, k_right.T, wts.T),
     )
     is_new = is_new.T                                    # [K, M] bool
@@ -187,9 +191,8 @@ compress = partial(jax.jit, static_argnames=("compression",),
                    donate_argnames=("bank",))(_compress_impl)
 
 
-@partial(jax.jit, static_argnames=("compression",), donate_argnames=("bank",))
-def add_batch(bank: TDigestBank, slots, values, weights,
-              compression: float = 100.0) -> TDigestBank:
+def _add_batch_impl(bank: TDigestBank, slots, values, weights,
+                    compression: float = 100.0) -> TDigestBank:
     """Scatter a batch of (slot, value, weight) samples into the bank.
 
     Batched equivalent of Histo.Sample -> MergingDigest.Add. Samples append
@@ -250,6 +253,10 @@ def add_batch(bank: TDigestBank, slots, values, weights,
     bank, _ = jax.lax.while_loop(
         cond, body, (bank, jnp.zeros_like(valid)))
     return bank
+
+
+add_batch = partial(jax.jit, static_argnames=("compression",),
+                    donate_argnames=("bank",))(_add_batch_impl)
 
 
 @partial(jax.jit, donate_argnames=("bank",))

@@ -1,0 +1,15 @@
+"""Multi-chip parallelism: device meshes, sharded banks, collective flush.
+
+The reference's parallelism (SURVEY §2.3) maps onto a 2D jax mesh:
+  * axis "shard" — hash-space partitioning of the slot axis, the TPU
+    analogue of `Workers[Digest % len(Workers)]` and of the proxy's
+    consistent-hash ring: each chip column owns a slice of the metric-key
+    space; no cross-chip traffic on the ingest hot path.
+  * axis "dp" — ingest data-parallel replicas, the analogue of
+    `num_readers`/multiple local veneurs: the same key space replicated so
+    independent sample streams can feed independent chips; at flush, the
+    replicas' sketch state is merged with ICI collectives (psum for
+    counters, pmax for HLL registers, all_gather+recluster for t-digest
+    centroids) — the reference's local→global sketch-forwarding tier,
+    collapsed into a single segmented all-reduce.
+"""

@@ -1,0 +1,242 @@
+"""MeshEngine: the full aggregation step SPMD over a ("dp", "shard") mesh.
+
+State layout: every bank array grows a leading dp axis and keeps its slot
+axis sharded — t-digest means are f32[D, K, C] with sharding
+P("dp", "shard", None): D ingest replicas × K slots split over shard
+columns. Sample batches are pre-routed on host (global slot id → owning
+shard column; any stream can feed any dp row), mirroring how veneur's
+digest sharding keeps the hot path synchronization-free
+(server.go: `Workers[Digest % len(Workers)]`).
+
+ingest_step: shard_map over both axes — each (dp, shard) program instance
+scatters its own [N] sample batch into its local bank slices with the
+single-chip kernels. Zero cross-chip traffic, by construction.
+
+flush_merged: the north-star kernel. ONE jitted SPMD program per interval:
+per shard column, the dp replicas' sketches merge over ICI —
+counters/count/sum psum; min/max pmin/pmax; HLL registers max-reduce;
+t-digest centroids all_gather along dp then recluster via the batched
+compress — then quantiles, aggregates and HLL estimates are computed for
+every slot. This one program subsumes the reference's Worker.Flush +
+Server.Flush tally/merge + the local→global Combine tier (flusher.go,
+importsrv/) for the intra-pod case; inter-pod (DCN) forwarding stays on
+veneur_tpu.cluster's forwardrpc contract.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import hll, scalar, tdigest
+from ..ops.tdigest import TDigestBank
+
+
+class MeshBanks(NamedTuple):
+    histo: TDigestBank           # arrays [D, K, ...]
+    counter: scalar.CounterBank  # [D, K]
+    gauge: scalar.GaugeBank      # [D, K]
+    sets: hll.HLLBank            # [D, K2, m]
+
+
+def make_mesh(n_dp: int = 1, n_shard: int | None = None,
+              devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if n_shard is None:
+        n_shard = len(devices) // n_dp
+    return Mesh(devices[: n_dp * n_shard].reshape(n_dp, n_shard),
+                ("dp", "shard"))
+
+
+def _bank_specs(banks: MeshBanks) -> MeshBanks:
+    """P("dp", "shard", None...) for every array: dp leading, slot axis
+    sharded, trailing dims local."""
+    return jax.tree.map(
+        lambda a: P("dp", "shard", *([None] * (a.ndim - 2))), banks)
+
+
+class MeshEngine:
+    """Owns the distributed banks and the two compiled SPMD programs."""
+
+    def __init__(self, mesh: Mesh, histogram_slots=1024, counter_slots=512,
+                 gauge_slots=512, set_slots=256, compression=100.0,
+                 buf_size=128, hll_precision=12,
+                 percentiles=(0.5, 0.75, 0.99)):
+        self.mesh = mesh
+        self.D = mesh.shape["dp"]
+        self.S = mesh.shape["shard"]
+        if histogram_slots % self.S or counter_slots % self.S \
+                or gauge_slots % self.S or set_slots % self.S:
+            raise ValueError("slot counts must divide the shard axis")
+        self.histogram_slots = histogram_slots
+        self.counter_slots = counter_slots
+        self.gauge_slots = gauge_slots
+        self.set_slots = set_slots
+        self.compression = compression
+        self.buf_size = buf_size
+        self.hll_precision = hll_precision
+        self.qs = jnp.asarray(percentiles, jnp.float32)
+        self._specs = None
+        self.banks = self._init_banks()
+        self._ingest_fn = self._build_ingest()
+        self._flush_fn = self._build_flush()
+
+    # -------------- state --------------
+
+    def _init_banks(self) -> MeshBanks:
+        def rep(bank):
+            return jax.tree.map(
+                lambda a: jnp.broadcast_to(a[None], (self.D,) + a.shape),
+                bank)
+
+        banks = MeshBanks(
+            histo=rep(tdigest.init(self.histogram_slots, self.compression,
+                                   self.buf_size)),
+            counter=rep(scalar.init_counters(self.counter_slots)),
+            gauge=rep(scalar.init_gauges(self.gauge_slots)),
+            sets=rep(hll.init(self.set_slots, self.hll_precision)),
+        )
+        if self._specs is None:
+            self._specs = _bank_specs(banks)
+        shardings = jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec), self._specs,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.tree.map(jax.device_put, banks, shardings)
+
+    # -------------- ingest step --------------
+
+    def _build_ingest(self):
+        comp = self.compression
+        batch_spec = P("dp", "shard")  # [D, S*N] -> per-instance [1, N]
+
+        def local(banks, hs, hv, hw, cs, cv, cw, gs, gv, gq, ss, si, sr):
+            sq = lambda a: a[0]
+            histo = jax.tree.map(sq, banks.histo)
+            histo = tdigest._add_batch_impl(histo, sq(hs), sq(hv), sq(hw),
+                                            comp)
+            counter = scalar.counter_add(jax.tree.map(sq, banks.counter),
+                                         sq(cs), sq(cv), sq(cw))
+            gauge = scalar.gauge_set(jax.tree.map(sq, banks.gauge),
+                                     sq(gs), sq(gv), sq(gq))
+            sets = hll.insert(jax.tree.map(sq, banks.sets),
+                              sq(ss), sq(si), sq(sr))
+            ex = lambda a: a[None]
+            return MeshBanks(jax.tree.map(ex, histo),
+                             jax.tree.map(ex, counter),
+                             jax.tree.map(ex, gauge),
+                             jax.tree.map(ex, sets))
+
+        shmapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._specs,) + (batch_spec,) * 12,
+            out_specs=self._specs)
+        return jax.jit(shmapped, donate_argnums=(0,))
+
+    def ingest(self, h_slots, h_vals, h_wts, c_slots, c_vals, c_wts,
+               g_slots, g_vals, g_seqs, s_slots, s_idx, s_rho):
+        """Sample arrays are [D, S*N]: row d feeds dp replica d; columns
+        are S per-shard segments of N, each holding LOCAL slot ids
+        (-1 padding)."""
+        self.banks = self._ingest_fn(
+            self.banks, h_slots, h_vals, h_wts, c_slots, c_vals, c_wts,
+            g_slots, g_vals, g_seqs, s_slots, s_idx, s_rho)
+
+    # -------------- merged flush --------------
+
+    def _build_flush(self):
+        comp = self.compression
+        qs = self.qs
+
+        def per_instance(histo, counter, gauge, sets):
+            sq = lambda a: a[0]
+            hb = jax.tree.map(sq, histo)
+            cb = jax.tree.map(sq, counter)
+            gb = jax.tree.map(sq, gauge)
+            sb = jax.tree.map(sq, sets)
+
+            # ---- t-digest: all_gather centroids over dp, recluster ----
+            hb = tdigest._compress_impl(hb, comp)
+            means = jax.lax.all_gather(hb.mean, "dp", axis=1, tiled=True)
+            wts = jax.lax.all_gather(hb.weight, "dp", axis=1, tiled=True)
+            merged = TDigestBank(
+                mean=jnp.zeros_like(hb.mean),
+                weight=jnp.zeros_like(hb.weight),
+                buf_value=means, buf_weight=wts,
+                buf_n=jnp.zeros_like(hb.buf_n),
+                vmin=jax.lax.pmin(hb.vmin, "dp"),
+                vmax=jax.lax.pmax(hb.vmax, "dp"),
+                vsum=jax.lax.psum(hb.vsum, "dp"),
+                count=jax.lax.psum(hb.count, "dp"),
+                recip=jax.lax.psum(hb.recip, "dp"),
+            )
+            merged = tdigest._compress_impl(merged, comp)
+            q = tdigest.quantile(merged, qs)
+            agg = tdigest.aggregates(merged)
+
+            # ---- scalars / HLL: pure collectives ----
+            c_total = jax.lax.psum(cb.hi + cb.lo, "dp")
+            g_seq = jax.lax.pmax(gb.seq, "dp")
+            g_val = jax.lax.pmax(
+                jnp.where((gb.seq == g_seq) & (g_seq >= 0), gb.value,
+                          -jnp.inf), "dp")
+            regs = jax.lax.pmax(sb.registers.astype(jnp.int32), "dp")
+            est = hll.estimate(hll.HLLBank(regs.astype(jnp.uint8)))
+            return q, agg, c_total, g_seq, g_val, est
+
+        out_specs = (
+            P("shard", None),
+            {k: P("shard") for k in
+             ("min", "max", "sum", "count", "avg", "hmean")},
+            P("shard"), P("shard"), P("shard"), P("shard"),
+        )
+        # check_vma=False: outputs ARE dp-replicated (they come from
+        # all_gather/psum/pmax over "dp"), but the varying-axes inference
+        # can't prove it for all_gather-derived values.
+        shmapped = jax.shard_map(
+            per_instance, mesh=self.mesh,
+            in_specs=tuple(self._specs), out_specs=out_specs,
+            check_vma=False)
+        return jax.jit(shmapped)
+
+    def flush_merged(self):
+        """Run the merged flush, reset state, return full-K host arrays."""
+        q, agg, c_total, g_seq, g_val, est = self._flush_fn(*self.banks)
+        out = jax.device_get({
+            "quantiles": q, "agg": agg, "counters": c_total,
+            "gauge_seq": g_seq, "gauge_val": g_val, "set_est": est})
+        self.banks = self._init_banks()
+        return out
+
+    # -------------- host-side batch routing helper --------------
+
+    def route_batch(self, slots, *arrays, slots_per_shard, n_per_segment,
+                    dp_row=0, n_dp=None, fill=0.0):
+        """Pack a host batch with GLOBAL slot ids into the [D, S*N]
+        layout ingest() expects: segment s holds the samples owned by
+        shard s with slot ids rebased to the shard-local range.
+
+        Returns (out_slots, *outs, n_overflow): samples beyond a shard's
+        segment capacity are NOT packed — callers must re-route them in
+        the next batch (or size n_per_segment for the worst case); the
+        count is returned so drops are never silent."""
+        n_dp = n_dp or self.D
+        slots = np.asarray(slots)
+        out_slots = np.full((n_dp, self.S * n_per_segment), -1, np.int32)
+        outs = [np.full((n_dp, self.S * n_per_segment), fill,
+                        np.asarray(a).dtype) for a in arrays]
+        overflow = 0
+        for s in range(self.S):
+            m = (slots >= 0) & (slots // slots_per_shard == s)
+            all_idx = np.nonzero(m)[0]
+            idx = all_idx[:n_per_segment]
+            overflow += len(all_idx) - len(idx)
+            base = s * n_per_segment
+            out_slots[dp_row, base:base + len(idx)] = (
+                slots[idx] % slots_per_shard)
+            for o, a in zip(outs, arrays):
+                o[dp_row, base:base + len(idx)] = np.asarray(a)[idx]
+        return (out_slots, *outs, overflow)

@@ -1,0 +1,392 @@
+"""The Server: listeners + sharded engines + flush loop + watchdog.
+
+Parity: server.go (sym: Server, NewFromConfig, Server.Start,
+Server.HandleMetricPacket, Server.ReadMetricSocket, Server.Shutdown),
+flusher.go (sym: Server.Flush, Server.FlushWatchdog), networking.go.
+
+Threading model (the Go goroutine topology, reshaped):
+  * `num_readers` UDP reader threads per listen address (SO_REUSEPORT
+    sockets — same kernel-level fan-in as the reference).
+  * Readers parse inline and route each sample by digest to one of
+    `num_workers` worker queues (`Workers[Digest % len(Workers)]`).
+  * Each worker thread owns one AggregationEngine feeding the device —
+    engines own disjoint hash-space shards, so flush is a union, never a
+    merge. Device calls release the GIL, so workers overlap.
+  * One flush thread ticks every `interval`, drains all engines, fans out
+    to sinks (thread per sink, timed), hands exports to the forwarder.
+  * A watchdog thread kills the process if flushes stop completing
+    (crash-only design: Server.FlushWatchdog panics for the supervisor
+    to restart).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import socket
+import threading
+import time
+
+from .config import Config
+from .ingest import parser
+from .metrics import InterMetric, MetricType
+from .models.pipeline import AggregationEngine, EngineConfig, ForwardExport
+from .sinks import MetricSink, filter_for_sink
+from .sinks.basic import (BlackholeMetricSink, DebugMetricSink,
+                          LocalFilePlugin)
+
+log = logging.getLogger("veneur_tpu.server")
+
+_STOP = object()
+
+
+class Server:
+    def __init__(self, cfg: Config, sinks: list[MetricSink] | None = None,
+                 plugins=None, forwarder=None):
+        self.cfg = cfg
+        self.hostname = cfg.hostname or (
+            "" if cfg.omit_empty_hostname else socket.gethostname())
+        n_workers = max(1, cfg.num_workers)
+        ecfg_kw = dict(
+            histogram_slots=max(256, cfg.tpu_histogram_slots // n_workers),
+            counter_slots=max(128, cfg.tpu_counter_slots // n_workers),
+            gauge_slots=max(128, cfg.tpu_gauge_slots // n_workers),
+            set_slots=max(64, cfg.tpu_set_slots // n_workers),
+            batch_size=cfg.tpu_batch_size,
+            buffer_depth=cfg.tpu_buffer_depth,
+            compression=cfg.tpu_compression,
+            hll_precision=cfg.tpu_hll_precision,
+            percentiles=tuple(cfg.percentiles),
+            aggregates=tuple(cfg.aggregates),
+            idle_ttl_intervals=cfg.tpu_slot_idle_ttl_intervals,
+            forward_enabled=bool(cfg.forward_address
+                                 or cfg.consul_forward_service_name),
+            # a server with a gRPC import listener is (also) a global tier
+            is_global=cfg.is_global or bool(cfg.grpc_listen_addresses),
+            hostname=self.hostname,
+        )
+        self.engines = [AggregationEngine(EngineConfig(**ecfg_kw))
+                        for _ in range(n_workers)]
+        self.worker_queues: list[queue.Queue] = [
+            queue.Queue(maxsize=65536) for _ in range(n_workers)]
+        self.sinks = sinks if sinks is not None else self._sinks_from_config()
+        self.plugins = plugins if plugins is not None else (
+            [LocalFilePlugin(cfg.flush_file,
+                             int(cfg.interval_seconds))]
+            if cfg.flush_file else [])
+        if forwarder is None and cfg.forward_address:
+            if cfg.forward_use_grpc:
+                from .cluster.forward import GrpcForwarder
+                forwarder = GrpcForwarder(cfg.forward_address)
+            else:
+                from .cluster.forward import HttpJsonForwarder
+                forwarder = HttpJsonForwarder(cfg.forward_address)
+        self.forwarder = forwarder   # callable(ForwardExport) or None
+        self._grpc_servers = []
+
+        self._threads: list[threading.Thread] = []
+        self._sockets: list[socket.socket] = []
+        self._stop = threading.Event()
+        self._last_flush_ok = time.monotonic()
+        self.flush_count = 0
+        # self-telemetry counters (veneur.* names at flush)
+        self.packets_received = 0
+        self.parse_errors = 0
+        self.queue_drops = 0
+        self._stats_lock = threading.Lock()
+
+    # ------------- construction helpers -------------
+
+    def _sinks_from_config(self) -> list[MetricSink]:
+        out: list[MetricSink] = []
+        cfg = self.cfg
+        if cfg.datadog_api_key:
+            from .sinks.datadog import DatadogMetricSink
+            out.append(DatadogMetricSink(
+                api_key=cfg.datadog_api_key,
+                api_url=cfg.datadog_api_hostname,
+                hostname=self.hostname,
+                tags=list(cfg.tags),
+                interval_s=int(cfg.interval_seconds),
+                flush_max_per_body=cfg.datadog_flush_max_per_body))
+        if cfg.signalfx_api_key:
+            from .sinks.signalfx import SignalFxMetricSink
+            out.append(SignalFxMetricSink(
+                api_key=cfg.signalfx_api_key,
+                endpoint=cfg.signalfx_endpoint_base,
+                hostname=self.hostname, tags=list(cfg.tags)))
+        if cfg.debug:
+            out.append(DebugMetricSink())
+        if not out:
+            out.append(BlackholeMetricSink())
+        return out
+
+    # ------------- lifecycle -------------
+
+    def start(self):
+        for s in self.sinks:
+            try:
+                s.start()
+            except Exception as e:
+                log.error("sink %s failed to start: %s", s.name(), e)
+        for i, q in enumerate(self.worker_queues):
+            t = threading.Thread(target=self._worker_loop, args=(i, q),
+                                 name=f"worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        for addr in self.cfg.statsd_listen_addresses:
+            self._start_statsd_listener(addr)
+        for addr in self.cfg.grpc_listen_addresses:
+            self._start_import_listener(addr)
+        t = threading.Thread(target=self._flush_loop, name="flusher",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.cfg.flush_watchdog_missed_flushes > 0:
+            t = threading.Thread(target=self._watchdog, name="watchdog",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        for g in self._grpc_servers:
+            try:
+                g.stop(0.5)
+            except Exception:
+                pass
+        for q in self.worker_queues:
+            try:
+                q.put_nowait(_STOP)
+            except queue.Full:
+                pass
+        for s in self._sockets:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for s in self.sinks:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+    # ------------- ingest -------------
+
+    def _start_statsd_listener(self, addr: str):
+        scheme, _, rest = addr.partition("://")
+        if scheme in ("udp", "udp4", "udp6"):
+            host, _, port = rest.rpartition(":")
+            for ri in range(max(1, self.cfg.num_readers)):
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                if hasattr(socket, "SO_REUSEPORT"):
+                    sock.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEPORT, 1)
+                try:
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                    self.cfg.read_buffer_size_bytes)
+                except OSError:
+                    pass
+                sock.bind((host or "0.0.0.0", int(port)))
+                self._sockets.append(sock)
+                t = threading.Thread(
+                    target=self._read_metric_socket, args=(sock,),
+                    name=f"udp-reader-{ri}", daemon=True)
+                t.start()
+                self._threads.append(t)
+        else:
+            raise ValueError(f"unsupported statsd listener {addr!r} "
+                             "(tcp/unix stream listeners arrive with SSF)")
+
+    def _start_import_listener(self, addr: str):
+        """Global-mode gRPC receive path (importsrv): forwarded metrics
+        are re-hashed onto the worker queues and merged via Combine."""
+        from .cluster.importsrv import start_import_server
+
+        nq = len(self.worker_queues)
+
+        def submit(digest, imported):
+            try:
+                self.worker_queues[digest % nq].put_nowait(imported)
+            except queue.Full:
+                with self._stats_lock:
+                    self.queue_drops += 1
+
+        server, port = start_import_server(addr, submit)
+        self._grpc_servers.append(server)
+        self.grpc_port = port
+
+    def bound_port(self) -> int:
+        """Port of the first UDP socket (for tests binding port 0)."""
+        return self._sockets[0].getsockname()[1]
+
+    def _read_metric_socket(self, sock: socket.socket):
+        """[HOT LOOP 1] recvfrom -> split -> parse -> route
+        (Server.ReadMetricSocket + HandleMetricPacket)."""
+        max_len = self.cfg.metric_max_length
+        nq = len(self.worker_queues)
+        while not self._stop.is_set():
+            try:
+                data, _ = sock.recvfrom(max_len)
+            except OSError:
+                break
+            self.handle_packet(data, nq)
+
+    def handle_packet(self, data: bytes, nq: int | None = None):
+        nq = nq or len(self.worker_queues)
+        with self._stats_lock:
+            self.packets_received += 1
+        for line in data.split(b"\n"):
+            if not line:
+                continue
+            try:
+                item = parser.parse_packet(line)
+            except parser.ParseError:
+                with self._stats_lock:
+                    self.parse_errors += 1
+                continue
+            if isinstance(item, parser.UDPMetric):
+                qi = item.digest % nq
+            else:
+                qi = 0
+            try:
+                self.worker_queues[qi].put_nowait(item)
+            except queue.Full:
+                # Deliberate lossiness under backpressure, counted —
+                # veneur drops on full worker channels the same way.
+                with self._stats_lock:
+                    self.queue_drops += 1
+
+    def _worker_loop(self, idx: int, q: queue.Queue):
+        """[HOT LOOP 2] queue -> engine (Worker.Work +
+        Worker.ImportMetricGRPC for forwarded metrics)."""
+        from .cluster.importsrv import ImportedMetric
+        from .cluster.wire import apply_metric_to_engine
+
+        eng = self.engines[idx]
+        while True:
+            item = q.get()
+            if item is _STOP:
+                break
+            if isinstance(item, parser.UDPMetric):
+                eng.process(item)
+            elif isinstance(item, ImportedMetric):
+                apply_metric_to_engine(eng, item.pb)
+            elif isinstance(item, parser.Event):
+                eng.process_event(item)
+            else:
+                eng.process_service_check(item)
+
+    # ------------- flush -------------
+
+    def _flush_loop(self):
+        interval = self.cfg.interval_seconds
+        next_t = time.monotonic() + interval
+        if self.cfg.synchronize_with_interval:
+            # align ticks to wall-clock multiples of the interval
+            now = time.time()
+            next_t = time.monotonic() + (interval - now % interval)
+        while not self._stop.wait(max(0.0, next_t - time.monotonic())):
+            next_t += interval
+            try:
+                self.flush_once()
+                self._last_flush_ok = time.monotonic()
+            except Exception:
+                log.exception("flush failed")
+
+    def flush_once(self, timestamp: int | None = None):
+        """One flush tick: drain engines, fan out, forward
+        (Server.Flush)."""
+        t0 = time.monotonic()
+        ts = int(timestamp if timestamp is not None else time.time())
+        all_metrics: list[InterMetric] = []
+        merged_export = ForwardExport()
+        events, checks = [], []
+        for eng in self.engines:
+            res = eng.flush(timestamp=ts)
+            all_metrics.extend(res.metrics)
+            merged_export.histograms.extend(res.export.histograms)
+            merged_export.sets.extend(res.export.sets)
+            merged_export.counters.extend(res.export.counters)
+            merged_export.gauges.extend(res.export.gauges)
+            ev, ch = eng.drain_events()
+            events.extend(ev)
+            checks.extend(ch)
+
+        all_metrics.extend(self._self_metrics(ts, t0))
+        self._fan_out(all_metrics, events, checks)
+
+        if self.forwarder is not None and (
+                merged_export.histograms or merged_export.sets
+                or merged_export.counters or merged_export.gauges):
+            try:
+                self.forwarder(merged_export)
+            except Exception:
+                log.exception("forward failed")
+        self.flush_count += 1
+        return all_metrics
+
+    def _self_metrics(self, ts: int, t0: float) -> list[InterMetric]:
+        """veneur.* self-telemetry (the internal statsd client's names)."""
+        with self._stats_lock:
+            packets, self.packets_received = self.packets_received, 0
+            perrs, self.parse_errors = self.parse_errors, 0
+            drops, self.queue_drops = self.queue_drops, 0
+        dur_ns = (time.monotonic() - t0) * 1e9
+        mk = lambda name, value, mt: InterMetric(
+            name=name, timestamp=ts, value=value, tags=[],
+            type=mt, hostname=self.hostname)
+        return [
+            mk("veneur.packet.received_total", packets, MetricType.COUNTER),
+            mk("veneur.packet.error_total", perrs, MetricType.COUNTER),
+            mk("veneur.worker.dropped_total", drops, MetricType.COUNTER),
+            mk("veneur.flush.total_duration_ns", dur_ns, MetricType.GAUGE),
+        ]
+
+    def _fan_out(self, metrics, events, checks):
+        """Per-sink parallel flush with timeout isolation (one goroutine
+        per sink in Server.Flush)."""
+        threads = []
+        for s in self.sinks:
+            def run(sink=s):
+                try:
+                    sink.flush(filter_for_sink(sink.name(), metrics))
+                    if events or checks:
+                        sink.flush_other(events, checks)
+                except Exception:
+                    log.exception("sink %s flush failed", sink.name())
+            t = threading.Thread(target=run, daemon=True,
+                                 name=f"sink-{s.name()}")
+            t.start()
+            threads.append(t)
+        for p in self.plugins:
+            def runp(plugin=p):
+                try:
+                    plugin.flush(metrics, self.hostname)
+                except Exception:
+                    log.exception("plugin %s flush failed", plugin.name())
+            t = threading.Thread(target=runp, daemon=True,
+                                 name=f"plugin-{p.name()}")
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + self.cfg.interval_seconds
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+    # ------------- watchdog -------------
+
+    def _watchdog(self):
+        """Crash-only supervision: exit hard if flushes stop completing
+        (Server.FlushWatchdog panics after watchdog_max_ticks)."""
+        max_lag = (self.cfg.flush_watchdog_missed_flushes
+                   * self.cfg.interval_seconds)
+        while not self._stop.wait(self.cfg.interval_seconds):
+            lag = time.monotonic() - self._last_flush_ok
+            if lag > max_lag:
+                log.critical(
+                    "flush watchdog: no completed flush in %.1fs "
+                    "(max %.1fs) — exiting for supervisor restart",
+                    lag, max_lag)
+                os._exit(2)

@@ -1,0 +1,122 @@
+"""Datadog metric/span sink — the reference's default egress.
+
+Parity: sinks/datadog/datadog.go (sym: DatadogMetricSink.Flush — POST
+/api/v1/series with JSON bodies chunked by `flush_max_per_body`;
+events + service checks; DatadogSpanSink → APM traces API).
+
+Semantics carried over:
+  * counters are emitted as Datadog "rate": value / interval, with the
+    interval attached (how the reference reports DogStatsD counters).
+  * gauges emit as "gauge"; metric hostname/device overrides via the
+    magic `host:` / `device:` tags.
+  * chunking: bodies hold at most `flush_max_per_body` series.
+
+Transport is stdlib urllib (zlib-deflated JSON like the reference), so the
+sink has no third-party deps; tests point `api_url` at a loopback
+http.server.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+import zlib
+
+from ..metrics import InterMetric, MetricType
+from . import MetricSink
+
+log = logging.getLogger("veneur_tpu.sinks.datadog")
+
+
+class DatadogMetricSink(MetricSink):
+    def __init__(self, api_key: str, api_url: str = "https://app.datadoghq.com",
+                 hostname: str = "", tags: list[str] | None = None,
+                 interval_s: int = 10, flush_max_per_body: int = 25_000,
+                 timeout_s: float = 10.0):
+        self.api_key = api_key
+        self.api_url = api_url.rstrip("/")
+        self.hostname = hostname
+        self.tags = tags or []
+        self.interval_s = interval_s
+        self.flush_max_per_body = flush_max_per_body
+        self.timeout_s = timeout_s
+
+    def name(self) -> str:
+        return "datadog"
+
+    def _series(self, m: InterMetric) -> dict:
+        if m.type == MetricType.COUNTER:
+            mtype, value = "rate", m.value / max(self.interval_s, 1)
+        else:
+            mtype, value = "gauge", m.value
+        host = m.hostname or self.hostname
+        device = ""
+        tags = list(self.tags)
+        for t in m.tags:
+            if t.startswith("host:"):
+                host = t[5:]
+            elif t.startswith("device:"):
+                device = t[7:]
+            else:
+                tags.append(t)
+        s = {
+            "metric": m.name,
+            "points": [[m.timestamp, value]],
+            "type": mtype,
+            "host": host,
+            "tags": tags,
+            "interval": self.interval_s,
+        }
+        if device:
+            s["device_name"] = device
+        return s
+
+    def _post(self, path: str, body: dict):
+        data = zlib.compress(json.dumps(body).encode())
+        req = urllib.request.Request(
+            f"{self.api_url}{path}?api_key={self.api_key}",
+            data=data,
+            headers={"Content-Type": "application/json",
+                     "Content-Encoding": "deflate"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            if resp.status >= 400:
+                raise RuntimeError(
+                    f"datadog POST {path}: HTTP {resp.status}")
+
+    def flush(self, metrics):
+        series = [self._series(m) for m in metrics]
+        for i in range(0, len(series), self.flush_max_per_body):
+            self._post("/api/v1/series",
+                       {"series": series[i:i + self.flush_max_per_body]})
+
+    def flush_other(self, events, checks):
+        for e in events:
+            body = {
+                "title": e.title, "text": e.text,
+                "aggregation_key": e.aggregation_key,
+                "priority": e.priority or "normal",
+                "source_type_name": e.source_type,
+                "alert_type": e.alert_type or "info",
+                "tags": e.tags,
+            }
+            if e.timestamp:
+                body["date_happened"] = e.timestamp
+            if e.hostname:
+                body["host"] = e.hostname
+            try:
+                self._post("/api/v1/events", body)
+            except Exception as ex:  # one bad event must not stop the rest
+                log.warning("datadog event post failed: %s", ex)
+        for c in checks:
+            body = {"check": c.name, "status": c.status,
+                    "tags": c.tags, "message": c.message}
+            if c.timestamp:
+                body["timestamp"] = c.timestamp
+            if c.hostname:
+                body["host_name"] = c.hostname
+            try:
+                self._post("/api/v1/check_run", body)
+            except Exception as ex:
+                log.warning("datadog check post failed: %s", ex)
