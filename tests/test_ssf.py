@@ -1,0 +1,401 @@
+"""SSF subsystem tests: framing roundtrip/robustness, sample helpers,
+ssfmetrics bridging, trace client, and end-to-end span ingest (UDP + TCP
+stream) into a real Server — the server_test.go / protocol wire_test.go
+strategies."""
+
+import io
+import socket
+import struct
+import time
+
+import pytest
+
+from veneur_tpu import ssf
+from veneur_tpu.config import read_config
+from veneur_tpu.ingest.parser import GLOBAL_ONLY
+from veneur_tpu.server import Server
+from veneur_tpu.sinks.basic import CaptureMetricSink
+from veneur_tpu.sinks.ssfmetrics import (SSFMetricsSink, indicator_timer,
+                                         sample_to_metric)
+from veneur_tpu.ssf import framing
+from veneur_tpu.ssf.protos import ssf_pb2
+from veneur_tpu import trace
+
+
+def make_span(name="op", service="svc", n_samples=0, **kw):
+    span = ssf_pb2.SSFSpan(
+        version=0, trace_id=7, id=8, parent_id=0,
+        start_timestamp=time.time_ns() - 1_000_000,
+        end_timestamp=time.time_ns(), name=name, service=service, **kw)
+    for i in range(n_samples):
+        span.metrics.append(ssf.count(f"sample.{i}", 1.0))
+    return span
+
+
+# ---------------- framing ----------------
+
+def test_frame_roundtrip():
+    span = make_span(n_samples=2)
+    buf = io.BytesIO(framing.write_ssf(span) + framing.write_ssf(span))
+    a = framing.read_ssf(buf)
+    b = framing.read_ssf(buf)
+    assert a.name == b.name == "op"
+    assert len(a.metrics) == 2
+    assert framing.read_ssf(buf) is None  # clean EOF
+
+
+def test_frame_bad_version():
+    with pytest.raises(framing.FramingError):
+        framing.read_ssf(io.BytesIO(b"\x01aaaa"))
+
+
+def test_frame_truncated():
+    good = framing.write_ssf(make_span())
+    with pytest.raises(EOFError):
+        framing.read_ssf(io.BytesIO(good[:-1]))
+    with pytest.raises(EOFError):
+        framing.read_ssf(io.BytesIO(good[:3]))
+
+
+def test_frame_oversized_rejected():
+    hdr = bytes([framing.VERSION_BYTE]) + struct.pack(
+        "<I", framing.MAX_FRAME_LENGTH + 1)
+    with pytest.raises(framing.FramingError):
+        framing.read_ssf(io.BytesIO(hdr + b"x" * 10))
+
+
+def test_frame_garbage_payload():
+    frame = bytes([framing.VERSION_BYTE]) + struct.pack("<I", 4) + b"\xff" * 4
+    with pytest.raises(framing.FramingError):
+        framing.read_ssf(io.BytesIO(frame))
+
+
+def test_validate_trace():
+    assert framing.validate_trace(make_span())
+    assert not framing.validate_trace(ssf_pb2.SSFSpan(service="bare"))
+
+
+# ---------------- sample helpers ----------------
+
+def test_sample_helpers():
+    c = ssf.count("reqs", 2.0, {"route": "/x"})
+    assert c.metric == ssf_pb2.SSFSample.COUNTER
+    assert c.tags["route"] == "/x"
+    t = ssf.timing("lat", 0.25, ssf.MILLISECOND)
+    assert t.metric == ssf_pb2.SSFSample.HISTOGRAM
+    assert t.value == pytest.approx(250.0)
+    assert t.unit == "ms"
+    s = ssf.set_sample("users", "u1")
+    assert s.message == "u1"
+
+
+def test_randomly_sample():
+    kept = ssf.randomly_sample(1.0, ssf.count("a", 1))
+    assert len(kept) == 1 and kept[0].sample_rate == 1.0
+
+    class AlwaysDrop:
+        @staticmethod
+        def random():
+            return 0.99
+    assert ssf.randomly_sample(0.5, ssf.count("a", 1),
+                               rng=AlwaysDrop) == []
+
+
+# ---------------- ssfmetrics conversion ----------------
+
+def test_sample_to_metric_types():
+    m = sample_to_metric(ssf.count("c", 3.0, {"k": "v"}))
+    assert m.key.type == "counter" and m.value == 3.0
+    assert m.key.joined_tags == "k:v"
+
+    m = sample_to_metric(ssf.timing("t", 0.1))
+    assert m.key.type == "timer"
+
+    m = sample_to_metric(ssf.histogram("h", 1.5))
+    assert m.key.type == "histogram"
+
+    m = sample_to_metric(ssf.set_sample("s", "member-1"))
+    assert m.key.type == "set" and m.value == "member-1"
+
+    assert sample_to_metric(ssf.status("sc", 1)) is None
+
+
+def test_sample_scope_mapping():
+    s = ssf.gauge("g", 1.0)
+    s.scope = ssf_pb2.SSFSample.GLOBAL
+    assert sample_to_metric(s).scope == GLOBAL_ONLY
+
+
+def test_indicator_timer():
+    span = make_span(indicator=True, error=True)
+    t = indicator_timer(span, "objective.latency")
+    assert t.key.type == "timer"
+    assert "error:true" in t.tags and "service:svc" in t.tags
+    assert indicator_timer(make_span(), "objective.latency") is None
+    assert indicator_timer(span, "") is None
+
+
+def test_ssfmetrics_sink_submits():
+    got = []
+    sink = SSFMetricsSink(got.append, "obj.timer")
+    sink.ingest(make_span(n_samples=3, indicator=True))
+    assert len(got) == 4  # 3 samples + indicator timer
+    assert sink.samples_extracted == 4
+
+
+# ---------------- end-to-end span ingest ----------------
+
+def ssf_server(**listeners):
+    cfg = read_config(text="""
+interval: "1s"
+num_workers: 2
+percentiles: [0.5]
+aggregates: ["count"]
+hostname: testhost
+tpu_histogram_slots: 512
+tpu_counter_slots: 512
+tpu_gauge_slots: 512
+tpu_set_slots: 256
+tpu_batch_size: 256
+tpu_buffer_depth: 64
+""")
+    for k, v in listeners.items():
+        setattr(cfg, k, v)
+    sink = CaptureMetricSink()
+    srv = Server(cfg, sinks=[sink])
+    return srv, sink
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_udp_ssf_end_to_end():
+    srv, sink = ssf_server(ssf_listen_addresses=["udp://127.0.0.1:0"])
+    srv.start()
+    try:
+        port = srv._sockets[-1].getsockname()[1]
+        out = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        span = make_span(n_samples=2)
+        out.sendto(span.SerializeToString(), ("127.0.0.1", port))
+        assert _wait(lambda: any(
+            s.samples_extracted >= 2 for s in srv.span_sinks
+            if isinstance(s, SSFMetricsSink)))
+        _wait(lambda: all(q.empty() for q in srv.worker_queues))
+        time.sleep(0.1)   # let in-flight worker items reach the engines
+        srv.flush_once()
+        names = {m.name for m in sink.all_metrics}
+        assert "sample.0" in names and "sample.1" in names
+        assert any(m.name == "veneur.ssf.received_total" and m.value >= 1
+                   for m in sink.all_metrics)
+    finally:
+        srv.stop()
+
+
+def test_tcp_ssf_stream_end_to_end():
+    srv, sink = ssf_server(ssf_listen_addresses=["tcp://127.0.0.1:0"])
+    srv.start()
+    try:
+        port = srv._listen_socks[0].getsockname()[1]
+        conn = socket.create_connection(("127.0.0.1", port))
+        for _ in range(3):
+            conn.sendall(framing.write_ssf(make_span(n_samples=1)))
+        assert _wait(lambda: srv.spans_received >= 3)
+        # a corrupt frame kills only this connection
+        conn.sendall(b"\x07garbage")
+        conn.close()
+        srv.flush_once()
+        assert any(m.name == "sample.0" for m in sink.all_metrics)
+    finally:
+        srv.stop()
+
+
+def test_trace_client_to_server():
+    srv, sink = ssf_server(ssf_listen_addresses=["udp://127.0.0.1:0"],
+                           indicator_span_timer_name="objective")
+    srv.start()
+    try:
+        port = srv._sockets[-1].getsockname()[1]
+        client = trace.Client(f"udp://127.0.0.1:{port}")
+        with trace.start_span(client, "parent", service="svc",
+                              indicator=True) as parent:
+            parent.add(ssf.count("traced.count", 5.0))
+            with trace.start_span(client, "child") as child:
+                assert child.trace_id == parent.trace_id
+                assert child.parent_id == parent.id
+        client.flush()
+        assert _wait(lambda: srv.spans_received >= 2)
+        srv.flush_once()
+        names = {m.name for m in sink.all_metrics}
+        assert "traced.count" in names
+        assert any(n.startswith("objective") for n in names)
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_report_batch():
+    srv, sink = ssf_server(ssf_listen_addresses=["udp://127.0.0.1:0"])
+    srv.start()
+    try:
+        port = srv._sockets[-1].getsockname()[1]
+        client = trace.Client(f"udp://127.0.0.1:{port}")
+        batch = ssf.Samples()
+        batch.add(ssf.count("batched", 2.0), ssf.gauge("g", 1.0))
+        assert trace.report_batch(client, batch, service="svc")
+        client.flush()
+        assert _wait(lambda: srv.spans_received >= 1)
+        srv.flush_once()
+        names = {m.name for m in sink.all_metrics}
+        assert "batched" in names and "g" in names
+        client.close()
+    finally:
+        srv.stop()
+
+
+# ---------------- span sinks ----------------
+
+def test_timer_unit_normalization():
+    # same 250ms duration in two units must produce the same ms value
+    a = sample_to_metric(ssf.timing("lat", 0.25, ssf.SECOND))
+    b = sample_to_metric(ssf.timing("lat", 0.25, ssf.MILLISECOND))
+    assert a.key == b.key
+    assert a.value == pytest.approx(250.0)
+    assert b.value == pytest.approx(250.0)
+
+
+def test_span_finish_idempotent():
+    sent = []
+
+    class FakeClient:
+        def record(self, span):
+            sent.append(span)
+
+    with trace.start_span(FakeClient(), "x", service="s") as sp:
+        sp.finish()
+    assert len(sent) == 1
+
+
+def test_splunk_span_sink():
+    import http.server
+    import threading
+
+    bodies = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            bodies.append((
+                self.path, self.headers.get("Authorization"),
+                self.rfile.read(int(self.headers["Content-Length"]))))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        from veneur_tpu.sinks.splunk import SplunkSpanSink
+        sink = SplunkSpanSink(
+            f"http://127.0.0.1:{httpd.server_port}", token="tok",
+            hostname="h1")
+        sink.ingest(make_span())
+        sink.ingest(make_span(name="op2"))
+        sink.flush()
+        assert sink.flushed_total == 2
+        path, auth, body = bodies[0]
+        assert path == "/services/collector/event"
+        assert auth == "Splunk tok"
+        import json
+        events = [json.loads(line) for line in body.decode().split("\n")]
+        assert events[0]["host"] == "h1"
+        assert events[0]["event"]["name"] == "op"
+        assert events[1]["event"]["name"] == "op2"
+    finally:
+        httpd.shutdown()
+
+
+def test_xray_span_sink():
+    import json
+
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(5.0)
+    from veneur_tpu.sinks.xray import XRaySpanSink
+    sink = XRaySpanSink(f"127.0.0.1:{recv.getsockname()[1]}")
+    span = make_span()
+    span.parent_id = 5
+    sink.ingest(span)
+    data, _ = recv.recvfrom(65536)
+    header, seg = data.split(b"\n", 1)
+    assert json.loads(header) == {"format": "json", "version": 1}
+    seg = json.loads(seg)
+    assert seg["name"] == "svc"
+    assert seg["trace_id"].startswith("1-")
+    assert seg["parent_id"] == f"{5:016x}"
+    sink.stop()
+    recv.close()
+
+
+def test_grpc_span_sink():
+    from veneur_tpu.sinks.grpsink import GrpcSpanSink, serve_capture
+
+    server, port, captured = serve_capture()
+    try:
+        sink = GrpcSpanSink(f"127.0.0.1:{port}")
+        sink.start()
+        sink.ingest(make_span(n_samples=1))
+        assert _wait(lambda: sink.sent_total == 1)  # async sender thread
+        assert len(captured) == 1 and captured[0].name == "op"
+        sink.stop()
+    finally:
+        server.stop(0)
+
+
+def test_server_stop_closes_stream_conns():
+    srv, _ = ssf_server(ssf_listen_addresses=["tcp://127.0.0.1:0"])
+    srv.start()
+    port = srv._listen_socks[0].getsockname()[1]
+    conn = socket.create_connection(("127.0.0.1", port))
+    conn.sendall(framing.write_ssf(make_span()))
+    assert _wait(lambda: srv.spans_received >= 1)
+    assert _wait(lambda: len(srv._stream_conns) == 1)
+    srv.stop()
+    assert _wait(lambda: len(srv._stream_conns) == 0)
+    conn.close()
+
+
+def test_status_sample_becomes_service_check():
+    from veneur_tpu.sinks.ssfmetrics import sample_to_check
+    s = ssf.status("db.health", 2, {"shard": "a"}, message="down")
+    ck = sample_to_check(s)
+    assert ck.name == "db.health" and ck.status == 2
+    assert ck.message == "down" and "shard:a" in ck.tags
+
+    got = []
+    sink = SSFMetricsSink(got.append)
+    span = make_span()
+    span.metrics.append(s)
+    sink.ingest(span)
+    assert len(got) == 1 and got[0].status == 2
+
+
+def test_ipv6_listeners():
+    srv, _ = ssf_server(
+        statsd_listen_addresses=["udp6://[::1]:0"],
+        ssf_listen_addresses=["tcp6://[::1]:0"])
+    srv.start()
+    try:
+        port = srv._sockets[0].getsockname()[1]
+        out = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+        out.sendto(b"v6.count:1|c", ("::1", port))
+        assert _wait(lambda: srv.packets_received >= 1)
+        assert srv._listen_socks[0].family == socket.AF_INET6
+    finally:
+        srv.stop()

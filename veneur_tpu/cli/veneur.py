@@ -19,10 +19,12 @@ def main(argv=None):
                     help="path to YAML config")
     ap.add_argument("--validate-config", action="store_true",
                     help="parse config and exit")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="enable debug logging")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
-        level=logging.DEBUG if "-v" in (argv or sys.argv) else logging.INFO,
+        level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
     from ..config import read_config

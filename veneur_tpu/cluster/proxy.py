@@ -117,12 +117,11 @@ class ProxyServer:
     def route_metrics(self, metrics) -> dict[str, list]:
         """Group metricpb.Metrics by owning destination."""
         groups: dict[str, list] = {}
-        for m in metrics:
-            key = wire.metric_key_of(m)
-            ring_key = f"{key.name}{key.type}{key.joined_tags}".encode()
-            with self._lock:
-                dest = self.ring.get(ring_key)
-            groups.setdefault(dest, []).append(m)
+        with self._lock:   # one acquisition per batch, not per metric
+            for m in metrics:
+                key = wire.metric_key_of(m)
+                ring_key = f"{key.name}{key.type}{key.joined_tags}".encode()
+                groups.setdefault(self.ring.get(ring_key), []).append(m)
         return groups
 
     def handle_metric_list(self, metric_list):

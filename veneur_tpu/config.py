@@ -76,6 +76,10 @@ class Config:
     # --- watchdog / lifecycle ---
     flush_watchdog_missed_flushes: int = 0
 
+    # --- SSF / tracing ---
+    indicator_span_timer_name: str = ""
+    ssf_buffer_size: int = 16384   # span worker queue depth
+
     # --- sinks ---
     datadog_api_key: str = ""
     datadog_api_hostname: str = "https://app.datadoghq.com"

@@ -43,7 +43,7 @@ _STOP = object()
 
 class Server:
     def __init__(self, cfg: Config, sinks: list[MetricSink] | None = None,
-                 plugins=None, forwarder=None):
+                 plugins=None, forwarder=None, span_sinks=None):
         self.cfg = cfg
         self.hostname = cfg.hostname or (
             "" if cfg.omit_empty_hostname else socket.gethostname())
@@ -87,6 +87,9 @@ class Server:
 
         self._threads: list[threading.Thread] = []
         self._sockets: list[socket.socket] = []
+        self._listen_socks: list[socket.socket] = []  # stream accept socks
+        self._stream_conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         self._stop = threading.Event()
         self._last_flush_ok = time.monotonic()
         self.flush_count = 0
@@ -94,7 +97,14 @@ class Server:
         self.packets_received = 0
         self.parse_errors = 0
         self.queue_drops = 0
+        self.spans_received = 0
+        self.ssf_errors = 0
         self._stats_lock = threading.Lock()
+        # SSF span pipeline (SpanWorker + SpanSinks)
+        self.span_queue: queue.Queue = queue.Queue(
+            maxsize=max(1, cfg.ssf_buffer_size))
+        self.span_sinks = (span_sinks if span_sinks is not None
+                           else self._span_sinks_from_config())
 
     # ------------- construction helpers -------------
 
@@ -122,6 +132,31 @@ class Server:
             out.append(BlackholeMetricSink())
         return out
 
+    def _span_sinks_from_config(self):
+        """Span egress: always include the ssfmetrics bridge so embedded
+        samples reach the metric pipeline (sinks/ssfmetrics)."""
+        from .sinks.ssfmetrics import SSFMetricsSink
+
+        out = [SSFMetricsSink(
+            self._route_metric,
+            indicator_span_timer_name=self.cfg.indicator_span_timer_name)]
+        if self.cfg.splunk_hec_address:
+            from .sinks.splunk import SplunkSpanSink
+            out.append(SplunkSpanSink(
+                hec_address=self.cfg.splunk_hec_address,
+                token=self.cfg.splunk_hec_token,
+                hostname=self.hostname))
+        if self.cfg.xray_address:
+            from .sinks.xray import XRaySpanSink
+            out.append(XRaySpanSink(daemon_address=self.cfg.xray_address))
+        if self.cfg.falconer_address:
+            from .sinks.grpsink import GrpcSpanSink
+            out.append(GrpcSpanSink(self.cfg.falconer_address))
+        if self.cfg.debug:
+            from .sinks.basic import BlackholeSpanSink
+            out.append(BlackholeSpanSink())
+        return out
+
     # ------------- lifecycle -------------
 
     def start(self):
@@ -137,8 +172,20 @@ class Server:
             self._threads.append(t)
         for addr in self.cfg.statsd_listen_addresses:
             self._start_statsd_listener(addr)
+        for addr in self.cfg.ssf_listen_addresses:
+            self._start_ssf_listener(addr)
         for addr in self.cfg.grpc_listen_addresses:
             self._start_import_listener(addr)
+        for ss in self.span_sinks:
+            try:
+                ss.start()
+            except Exception as e:
+                log.error("span sink %s failed to start: %s",
+                          ss.name(), e)
+        t = threading.Thread(target=self._span_worker, name="span-worker",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
         t = threading.Thread(target=self._flush_loop, name="flusher",
                              daemon=True)
         t.start()
@@ -161,12 +208,25 @@ class Server:
                 q.put_nowait(_STOP)
             except queue.Full:
                 pass
-        for s in self._sockets:
+        try:
+            self.span_queue.put_nowait(_STOP)
+        except queue.Full:
+            pass
+        with self._conns_lock:
+            conns = list(self._stream_conns)
+        for c in conns:
+            # shutdown (not just close) so reader threads blocked in
+            # recv() wake up immediately
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for s in self._sockets + self._listen_socks + conns:
             try:
                 s.close()
             except OSError:
                 pass
-        for s in self.sinks:
+        for s in self.sinks + self.span_sinks:
             try:
                 s.stop()
             except Exception:
@@ -174,12 +234,29 @@ class Server:
 
     # ------------- ingest -------------
 
+    @staticmethod
+    def _resolve_inet(scheme: str, rest: str):
+        """'host:port' (+scheme suffix 4/6, brackets allowed) → (family,
+        bind_addr). udp6://[::1]:8126 must bind an AF_INET6 socket."""
+        host, _, port = rest.rpartition(":")
+        host = host.strip("[]")
+        if scheme.endswith("6"):
+            family = socket.AF_INET6
+            host = host or "::"
+        elif scheme.endswith("4"):
+            family = socket.AF_INET
+            host = host or "0.0.0.0"
+        else:
+            family = socket.AF_INET6 if ":" in host else socket.AF_INET
+            host = host or "0.0.0.0"
+        return family, (host, int(port))
+
     def _start_statsd_listener(self, addr: str):
         scheme, _, rest = addr.partition("://")
         if scheme in ("udp", "udp4", "udp6"):
-            host, _, port = rest.rpartition(":")
+            family, bind_addr = self._resolve_inet(scheme, rest)
             for ri in range(max(1, self.cfg.num_readers)):
-                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                sock = socket.socket(family, socket.SOCK_DGRAM)
                 sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
                 if hasattr(socket, "SO_REUSEPORT"):
                     sock.setsockopt(socket.SOL_SOCKET,
@@ -189,7 +266,7 @@ class Server:
                                     self.cfg.read_buffer_size_bytes)
                 except OSError:
                     pass
-                sock.bind((host or "0.0.0.0", int(port)))
+                sock.bind(bind_addr)
                 self._sockets.append(sock)
                 t = threading.Thread(
                     target=self._read_metric_socket, args=(sock,),
@@ -199,6 +276,126 @@ class Server:
         else:
             raise ValueError(f"unsupported statsd listener {addr!r} "
                              "(tcp/unix stream listeners arrive with SSF)")
+
+    def _start_ssf_listener(self, addr: str):
+        """SSF ingest (Server.StartSSF): udp:// datagrams carry bare
+        SSFSpan protobufs; tcp:// and unix:// carry framed streams
+        (protocol.ReadSSF)."""
+        scheme, _, rest = addr.partition("://")
+        if scheme in ("udp", "udp4", "udp6"):
+            family, bind_addr = self._resolve_inet(scheme, rest)
+            sock = socket.socket(family, socket.SOCK_DGRAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(bind_addr)
+            self._sockets.append(sock)
+            t = threading.Thread(target=self._read_ssf_packet_socket,
+                                 args=(sock,), name="ssf-udp-reader",
+                                 daemon=True)
+        elif scheme in ("tcp", "tcp4", "tcp6", "unix"):
+            if scheme != "unix":
+                family, bind_addr = self._resolve_inet(scheme, rest)
+                lsock = socket.socket(family, socket.SOCK_STREAM)
+                lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                lsock.bind(bind_addr)
+            else:
+                if os.path.exists(rest):
+                    os.unlink(rest)
+                lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                lsock.bind(rest)
+            lsock.listen(128)
+            self._listen_socks.append(lsock)
+            t = threading.Thread(target=self._accept_ssf_streams,
+                                 args=(lsock,), name=f"ssf-{scheme}-accept",
+                                 daemon=True)
+        else:
+            raise ValueError(f"unsupported SSF listener {addr!r}")
+        t.start()
+        self._threads.append(t)
+
+    def _read_ssf_packet_socket(self, sock: socket.socket):
+        """Server.ReadSSFPacketSocket: one datagram = one SSFSpan."""
+        from .ssf import framing
+
+        max_len = self.cfg.trace_max_length_bytes
+        while not self._stop.is_set():
+            try:
+                data, _ = sock.recvfrom(max_len)
+            except OSError:
+                break
+            try:
+                span = framing.parse_ssf_datagram(data)
+            except framing.FramingError:
+                with self._stats_lock:
+                    self.ssf_errors += 1
+                continue
+            self.handle_ssf_span(span)
+
+    def _accept_ssf_streams(self, lsock: socket.socket):
+        while not self._stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                break
+            with self._conns_lock:
+                self._stream_conns.add(conn)
+            threading.Thread(target=self._read_ssf_stream, args=(conn,),
+                             name="ssf-stream", daemon=True).start()
+
+    def _read_ssf_stream(self, conn: socket.socket):
+        """Server.HandleTracePacket over a framed stream; a corrupt
+        frame poisons only its own connection."""
+        from .ssf import framing
+
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    try:
+                        span = framing.read_ssf(conn)
+                    except (framing.FramingError, EOFError, OSError):
+                        with self._stats_lock:
+                            self.ssf_errors += 1
+                        return
+                    if span is None:
+                        return
+                    self.handle_ssf_span(span)
+        finally:
+            with self._conns_lock:
+                self._stream_conns.discard(conn)
+
+    def handle_ssf_span(self, span):
+        """Route one ingested span to the SpanWorker (drop-on-full,
+        counted, like the reference's SpanChan)."""
+        with self._stats_lock:
+            self.spans_received += 1
+        try:
+            self.span_queue.put_nowait(span)
+        except queue.Full:
+            with self._stats_lock:
+                self.queue_drops += 1
+
+    def _span_worker(self):
+        """SpanWorker: fan each span out to every span sink."""
+        while True:
+            span = self.span_queue.get()
+            if span is _STOP:
+                break
+            for ss in self.span_sinks:
+                try:
+                    ss.ingest(span)
+                except Exception:
+                    log.exception("span sink %s ingest failed", ss.name())
+
+    def _route_metric(self, item):
+        """Digest-route one UDPMetric onto a worker queue (shared by the
+        packet path and the ssfmetrics bridge); events/service checks
+        have no digest and ride on queue 0 like the packet path."""
+        qi = item.digest % len(self.worker_queues) \
+            if hasattr(item, "digest") else 0
+        try:
+            self.worker_queues[qi].put_nowait(item)
+        except queue.Full:
+            with self._stats_lock:
+                self.queue_drops += 1
 
     def _start_import_listener(self, addr: str):
         """Global-mode gRPC receive path (importsrv): forwarded metrics
@@ -334,6 +531,8 @@ class Server:
             packets, self.packets_received = self.packets_received, 0
             perrs, self.parse_errors = self.parse_errors, 0
             drops, self.queue_drops = self.queue_drops, 0
+            spans, self.spans_received = self.spans_received, 0
+            sserrs, self.ssf_errors = self.ssf_errors, 0
         dur_ns = (time.monotonic() - t0) * 1e9
         mk = lambda name, value, mt: InterMetric(
             name=name, timestamp=ts, value=value, tags=[],
@@ -342,6 +541,8 @@ class Server:
             mk("veneur.packet.received_total", packets, MetricType.COUNTER),
             mk("veneur.packet.error_total", perrs, MetricType.COUNTER),
             mk("veneur.worker.dropped_total", drops, MetricType.COUNTER),
+            mk("veneur.ssf.received_total", spans, MetricType.COUNTER),
+            mk("veneur.ssf.error_total", sserrs, MetricType.COUNTER),
             mk("veneur.flush.total_duration_ns", dur_ns, MetricType.GAUGE),
         ]
 
@@ -369,6 +570,17 @@ class Server:
                     log.exception("plugin %s flush failed", plugin.name())
             t = threading.Thread(target=runp, daemon=True,
                                  name=f"plugin-{p.name()}")
+            t.start()
+            threads.append(t)
+        for ss in self.span_sinks:
+            def runs(sink=ss):
+                try:
+                    sink.flush()
+                except Exception:
+                    log.exception("span sink %s flush failed",
+                                  sink.name())
+            t = threading.Thread(target=runs, daemon=True,
+                                 name=f"spansink-{ss.name()}")
             t.start()
             threads.append(t)
         deadline = time.monotonic() + self.cfg.interval_seconds

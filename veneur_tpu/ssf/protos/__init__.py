@@ -1,0 +1,12 @@
+"""Generated protobuf module for SSF (protoc --python_out)."""
+
+import os
+import sys
+
+_here = os.path.dirname(__file__)
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+import ssf_pb2  # noqa: E402
+
+__all__ = ["ssf_pb2"]
