@@ -1,0 +1,151 @@
+"""HTTP API tests: healthcheck/version/debug endpoints and the legacy
+JSON /import path — a full two-tier local→global flow over loopback HTTP
+(the handlers_global.go / flusher_test.go strategy)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu import __version__
+from veneur_tpu.config import read_config
+from veneur_tpu.ingest import parser
+from veneur_tpu.server import Server
+from veneur_tpu.sinks.basic import CaptureMetricSink
+
+CFG = """
+interval: "1s"
+num_workers: 2
+percentiles: [0.5, 0.99]
+aggregates: ["count", "max"]
+hostname: testhost
+tpu_histogram_slots: 512
+tpu_counter_slots: 512
+tpu_gauge_slots: 512
+tpu_set_slots: 256
+tpu_batch_size: 256
+tpu_buffer_depth: 128
+"""
+
+
+def make_server(**overrides):
+    cfg = read_config(text=CFG)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    sink = CaptureMetricSink()
+    srv = Server(cfg, sinks=[sink])
+    return srv, sink
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read()
+
+
+def test_ops_endpoints():
+    srv, _ = make_server(http_address="127.0.0.1:0")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.http_api.port}"
+        assert get(f"{base}/healthcheck") == (200, b"ok\n")
+        assert get(f"{base}/healthcheck/tcp") == (200, b"ok\n")
+        assert get(f"{base}/version")[1].decode().strip() == __version__
+        assert get(f"{base}/builddate")[0] == 200
+        status, body = get(f"{base}/debug/threads")
+        assert status == 200 and b"flusher" in body
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(f"{base}/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_http_import_two_tier():
+    """local engines flush → HttpJsonForwarder → global /import →
+    global flush produces correct global percentiles (±1%)."""
+    glob, gsink = make_server(http_address="127.0.0.1:0", is_global=True,
+                              interval="60s")
+    glob.start()
+    try:
+        from veneur_tpu.cluster.forward import HttpJsonForwarder
+        fwd = HttpJsonForwarder(f"http://127.0.0.1:{glob.http_api.port}")
+
+        rng = np.random.default_rng(3)
+        vals = rng.normal(100, 15, 4000)
+        locals_ = []
+        for shard in range(2):
+            srv, _ = make_server(forward_address="placeholder")
+            srv.forwarder = fwd
+            # feed engines synchronously (worker threads not started)
+            for v in vals[shard::2]:
+                m = parser.parse_metric(f"fwd.timer:{v}|ms".encode())
+                srv.engines[m.digest % len(srv.engines)].process(m)
+            locals_.append(srv)
+        for srv in locals_:
+            srv.flush_once()
+        # global side: wait for import queue to drain, then flush
+        time.sleep(0.5)
+        glob.flush_once()
+        by_name = {m.name: m.value for m in gsink.all_metrics}
+        assert by_name.get("fwd.timer.count") == pytest.approx(4000)
+        p50 = by_name["fwd.timer.50percentile"]
+        assert abs(p50 - np.quantile(vals, 0.5)) / p50 < 0.01
+        p99 = by_name["fwd.timer.99percentile"]
+        rank = (vals <= p99).mean()
+        assert abs(rank - 0.99) < 0.01
+        assert by_name["fwd.timer.max"] == pytest.approx(vals.max(),
+                                                         rel=1e-5)
+        for srv in locals_:
+            srv.stop()
+    finally:
+        glob.stop()
+
+
+def test_http_import_bad_body():
+    srv, _ = make_server(http_address="127.0.0.1:0", is_global=True)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.http_api.port}"
+        req = urllib.request.Request(
+            f"{base}/import", data=b'[{"name": "x", "type": "bogus"}]',
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_import_counter_and_set_roundtrip():
+    glob, gsink = make_server(http_address="127.0.0.1:0", is_global=True,
+                              interval="60s")
+    glob.start()
+    try:
+        from veneur_tpu.cluster.forward import HttpJsonForwarder
+        fwd = HttpJsonForwarder(f"http://127.0.0.1:{glob.http_api.port}")
+        srv, lsink = make_server(forward_address="placeholder")
+        srv.forwarder = fwd
+        for i in range(100):
+            # global-only counters forward; mixed counters stay local;
+            # mixed sets always forward (global uniques)
+            for line in (b"fwd.gcount:2|c|#veneurglobalonly",
+                         b"fwd.localcount:1|c",
+                         f"fwd.uniq:user{i % 25}|s".encode()):
+                m = parser.parse_metric(line)
+                srv.engines[m.digest % len(srv.engines)].process(m)
+        srv.flush_once()
+        time.sleep(0.5)
+        glob.flush_once()
+        by_name = {m.name: m.value for m in gsink.all_metrics}
+        assert by_name.get("fwd.gcount") == pytest.approx(200)
+        assert by_name.get("fwd.uniq") == pytest.approx(25, rel=0.05)
+        assert "fwd.localcount" not in by_name
+        local_names = {m.name: m.value for m in lsink.all_metrics}
+        assert local_names.get("fwd.localcount") == pytest.approx(100)
+        assert "fwd.gcount" not in local_names
+        srv.stop()
+    finally:
+        glob.stop()

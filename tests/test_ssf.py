@@ -175,6 +175,15 @@ def _wait(pred, timeout=5.0):
     return False
 
 
+def _drain_and_flush(srv):
+    """Wait for the span worker + metric workers to drain, then flush —
+    the ingest path is asynchronous end to end."""
+    _wait(lambda: srv.span_queue.empty())
+    _wait(lambda: all(q.empty() for q in srv.worker_queues))
+    time.sleep(0.1)   # let in-flight items reach the engines
+    srv.flush_once()
+
+
 def test_udp_ssf_end_to_end():
     srv, sink = ssf_server(ssf_listen_addresses=["udp://127.0.0.1:0"])
     srv.start()
@@ -186,9 +195,7 @@ def test_udp_ssf_end_to_end():
         assert _wait(lambda: any(
             s.samples_extracted >= 2 for s in srv.span_sinks
             if isinstance(s, SSFMetricsSink)))
-        _wait(lambda: all(q.empty() for q in srv.worker_queues))
-        time.sleep(0.1)   # let in-flight worker items reach the engines
-        srv.flush_once()
+        _drain_and_flush(srv)
         names = {m.name for m in sink.all_metrics}
         assert "sample.0" in names and "sample.1" in names
         assert any(m.name == "veneur.ssf.received_total" and m.value >= 1
@@ -209,7 +216,7 @@ def test_tcp_ssf_stream_end_to_end():
         # a corrupt frame kills only this connection
         conn.sendall(b"\x07garbage")
         conn.close()
-        srv.flush_once()
+        _drain_and_flush(srv)
         assert any(m.name == "sample.0" for m in sink.all_metrics)
     finally:
         srv.stop()
@@ -230,7 +237,7 @@ def test_trace_client_to_server():
                 assert child.parent_id == parent.id
         client.flush()
         assert _wait(lambda: srv.spans_received >= 2)
-        srv.flush_once()
+        _drain_and_flush(srv)
         names = {m.name for m in sink.all_metrics}
         assert "traced.count" in names
         assert any(n.startswith("objective") for n in names)
@@ -250,7 +257,7 @@ def test_report_batch():
         assert trace.report_batch(client, batch, service="svc")
         client.flush()
         assert _wait(lambda: srv.spans_received >= 1)
-        srv.flush_once()
+        _drain_and_flush(srv)
         names = {m.name for m in sink.all_metrics}
         assert "batched" in names and "g" in names
         client.close()

@@ -1,0 +1,136 @@
+"""veneur-prometheus: poll a Prometheus /metrics endpoint and relay the
+families to a veneur as DogStatsD.
+
+Parity: cmd/veneur-prometheus/main.go (sym: main): on an interval, GET
+the exposition text, translate each family — counters as deltas since
+the previous poll (first poll primes the cache), gauges as absolute
+values, histogram/summary components as their counter/gauge parts — and
+emit statsd lines with the Prometheus labels as tags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import re
+import socket
+import sys
+import time
+import urllib.request
+
+log = logging.getLogger("veneur-prometheus")
+
+_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+"
+    r"(?P<value>[^\s]+)(?:\s+\d+)?$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """Prometheus text format → [(name, labels dict, value, type)].
+    TYPE comments drive counter/gauge classification; untyped series
+    default to gauge."""
+    types: dict[str, str] = {}
+    out = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        name = m.group("name")
+        labels = dict(_LABEL.findall(m.group("labels") or ""))
+        base = name
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                base = name[:-len(suffix)]
+                break
+        ftype = types.get(base, types.get(name, "gauge"))
+        out.append((name, labels, value, ftype))
+    return out
+
+
+def to_statsd_lines(samples, prev: dict, prefix: str = "",
+                    ignored_labels=()):
+    """Translate one poll; `prev` carries last cumulative values for
+    delta-ing counters (mutated in place)."""
+    lines = []
+    for name, labels, value, ftype in samples:
+        labels = {k: v for k, v in labels.items()
+                  if k not in ignored_labels}
+        tagstr = ",".join(f"{k}:{v}" for k, v in sorted(labels.items()))
+        key = (name, tagstr)
+        mname = prefix + name
+        if ftype in ("counter", "histogram", "summary") and (
+                name.endswith(("_total", "_count", "_bucket"))
+                or ftype == "counter"):
+            last = prev.get(key)
+            prev[key] = value
+            if last is None or value < last:   # first poll / reset
+                continue
+            delta = value - last
+            if delta == 0:
+                continue
+            line = f"{mname}:{delta}|c"
+        else:
+            line = f"{mname}:{value}|g"
+        if tagstr:
+            line += f"|#{tagstr}"
+        lines.append(line.encode())
+    return lines
+
+
+def poll_once(url: str, prev: dict, prefix: str = "",
+              timeout_s: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        text = resp.read().decode("utf-8", "replace")
+    return to_statsd_lines(parse_exposition(text), prev, prefix)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="veneur-prometheus")
+    ap.add_argument("-p", "--prometheus-host",
+                    default="http://localhost:9090/metrics",
+                    help="metrics endpoint to poll")
+    ap.add_argument("-s", "--statsd-host", default="127.0.0.1:8126",
+                    help="veneur statsd address")
+    ap.add_argument("-i", "--interval", type=float, default=10.0)
+    ap.add_argument("--prefix", default="", help="metric name prefix")
+    ap.add_argument("--once", action="store_true",
+                    help="poll twice back-to-back and exit (testing)")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    host, _, port = args.statsd_host.rpartition(":")
+    dest = (host or "127.0.0.1", int(port))
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    prev: dict = {}
+    n_polls = 0
+    while True:
+        try:
+            lines = poll_once(args.prometheus_host, prev, args.prefix)
+            for ln in lines:
+                sock.sendto(ln, dest)
+            log.info("relayed %d series", len(lines))
+        except Exception as e:
+            log.error("poll failed: %s", e)
+        n_polls += 1
+        if args.once and n_polls >= 2:
+            return 0
+        time.sleep(args.interval if not args.once else 0.05)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

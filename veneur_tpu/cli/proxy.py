@@ -1,0 +1,67 @@
+"""veneur-proxy: consistent-hash metrics across the global tier.
+
+Parity: cmd/veneur-proxy/main.go (sym: main) + proxy.go (sym:
+NewProxyFromConfig). Reads a YAML config (the reference's proxy config
+keys), builds a Discoverer (consul or static `forward_destinations`),
+and serves the forwardrpc contract, re-routing each metric by its key
+digest onto the owning global veneur.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+import yaml
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="veneur-proxy")
+    ap.add_argument("-f", dest="config", required=True,
+                    help="path to proxy YAML config")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    with open(args.config) as f:
+        cfg = yaml.safe_load(f) or {}
+
+    from ..cluster.discovery import ConsulDiscoverer, StaticDiscoverer
+    from ..cluster.proxy import ProxyServer
+
+    service = cfg.get("consul_forward_service_name", "")
+    if service:
+        disc = ConsulDiscoverer(
+            cfg.get("consul_url", "http://127.0.0.1:8500"))
+    else:
+        static = cfg.get("forward_destinations", [])
+        if not static:
+            print("proxy config needs consul_forward_service_name or "
+                  "forward_destinations", file=sys.stderr)
+            return 1
+        disc = StaticDiscoverer(static)
+
+    refresh = float(str(cfg.get("consul_refresh_interval", "30")).rstrip("s"))
+    proxy = ProxyServer(disc, service_name=service,
+                        refresh_interval_s=refresh)
+    addr = cfg.get("grpc_address", "0.0.0.0:8128")
+    proxy.start(addr)
+    logging.getLogger("veneur-proxy").info(
+        "proxying on %s -> %d destinations", addr, len(proxy.ring))
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""Debug/ops HTTP server: healthchecks, version, and the legacy JSON
+import path.
+
+Parity: handlers.go (sym: Server.Serve / HTTPServe — /healthcheck,
+/healthcheck/tcp, /version, /builddate) and handlers_global.go (sym:
+Server.handleImport — POST /import with a []JSONMetric body; the Go gob
+digest blobs are JSON centroid arrays here, matching what
+cluster.forward.HttpJsonForwarder emits). The reference also exposes
+net/http/pprof; the Python analogue is GET /debug/threads (a stack dump
+of every thread).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import __version__
+from .cluster import wire
+from .cluster.protos import metric_pb2
+from .ingest.parser import MetricKey
+from .utils.hashing import metric_digest
+
+log = logging.getLogger("veneur_tpu.http")
+
+BUILD_DATE = "dev"
+
+_TYPE_TO_PB = {
+    "counter": metric_pb2.Counter,
+    "gauge": metric_pb2.Gauge,
+    "histogram": metric_pb2.Histogram,
+    "timer": metric_pb2.Timer,
+    "set": metric_pb2.Set,
+}
+
+
+def json_metric_to_pb(d: dict) -> metric_pb2.Metric:
+    """One JSONMetric dict → metricpb.Metric, so the HTTP import path
+    reuses the gRPC path's merge machinery (handleImport →
+    Worker.ImportMetric equivalence)."""
+    mtype = d.get("type", "")
+    if mtype not in _TYPE_TO_PB:
+        raise ValueError(f"unknown metric type {mtype!r}")
+    m = metric_pb2.Metric(name=d["name"], type=_TYPE_TO_PB[mtype],
+                          tags=list(d.get("tags", [])))
+    if mtype in ("histogram", "timer"):
+        h = d["histogram"]
+        td = m.histogram.t_digest
+        for c in h.get("centroids", []):
+            if float(c[1]) > 0:
+                td.centroids.add(mean=float(c[0]), weight=float(c[1]))
+        td.min = float(h.get("min", 0.0))
+        td.max = float(h.get("max", 0.0))
+        td.sum = float(h.get("sum", 0.0))
+        td.count = float(h.get("count", 0.0))
+        td.reciprocal_sum = float(h.get("reciprocal_sum", 0.0))
+    elif mtype == "set":
+        m.set.hyper_log_log = bytes.fromhex(d["set"])
+    elif mtype == "counter":
+        m.counter.value = int(d["value"])
+    elif mtype == "gauge":
+        m.gauge.value = float(d["value"])
+    return m
+
+
+class HttpApi:
+    """The ops HTTP listener; `submit(digest, pb_metric)` routes an
+    imported metric onto a worker queue (the Server provides it)."""
+
+    def __init__(self, address: str, submit=None, healthy=None):
+        host, _, port = address.rpartition(":")
+        host = host.strip("[]") or "0.0.0.0"
+        self._submit = submit
+        self._healthy = healthy or (lambda: True)
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet; logrus-style app logs
+                pass
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "text/plain"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/healthcheck", "/healthcheck/tcp"):
+                    if api._healthy():
+                        self._reply(200, b"ok\n")
+                    else:
+                        self._reply(503, b"unhealthy\n")
+                elif self.path == "/version":
+                    self._reply(200, __version__.encode() + b"\n")
+                elif self.path == "/builddate":
+                    self._reply(200, BUILD_DATE.encode() + b"\n")
+                elif self.path == "/debug/threads":
+                    frames = sys._current_frames()
+                    out = []
+                    for t in threading.enumerate():
+                        out.append(f"--- {t.name} ({t.ident}) ---")
+                        f = frames.get(t.ident)
+                        if f is not None:
+                            out.extend(traceback.format_stack(f))
+                    self._reply(200, "\n".join(out).encode())
+                else:
+                    self._reply(404, b"not found\n")
+
+            def do_POST(self):
+                if self.path != "/import":
+                    self._reply(404, b"not found\n")
+                    return
+                if api._submit is None:
+                    self._reply(503, b"not a global veneur\n")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n))
+                    count = 0
+                    for d in body:
+                        pb = json_metric_to_pb(d)
+                        key = wire.metric_key_of(pb)
+                        digest = metric_digest(key.name, key.type,
+                                               key.joined_tags)
+                        api._submit(digest, pb)
+                        count += 1
+                except (ValueError, KeyError, TypeError) as e:
+                    self._reply(400, f"bad import body: {e}\n".encode())
+                    return
+                self._reply(200, json.dumps({"imported": count}).encode(),
+                            "application/json")
+
+        self._httpd = ThreadingHTTPServer((host, int(port or 0)), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="http-api", daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -186,6 +186,8 @@ class Server:
                              daemon=True)
         t.start()
         self._threads.append(t)
+        if self.cfg.http_address:
+            self._start_http_api(self.cfg.http_address)
         t = threading.Thread(target=self._flush_loop, name="flusher",
                              daemon=True)
         t.start()
@@ -198,6 +200,11 @@ class Server:
 
     def stop(self):
         self._stop.set()
+        if getattr(self, "http_api", None) is not None:
+            try:
+                self.http_api.stop()
+            except Exception:
+                pass
         for g in self._grpc_servers:
             try:
                 g.stop(0.5)
@@ -386,9 +393,11 @@ class Server:
                     log.exception("span sink %s ingest failed", ss.name())
 
     def _route_metric(self, item):
-        """Digest-route one UDPMetric onto a worker queue (shared by the
-        packet path and the ssfmetrics bridge); events/service checks
-        have no digest and ride on queue 0 like the packet path."""
+        """Digest-route one item onto a worker queue — the single
+        dispatch point shared by the packet path and the ssfmetrics
+        bridge. Events/service checks have no digest and ride on
+        queue 0. Drop-on-full is deliberate lossiness under
+        backpressure, counted, like veneur's full worker channels."""
         qi = item.digest % len(self.worker_queues) \
             if hasattr(item, "digest") else 0
         try:
@@ -415,6 +424,26 @@ class Server:
         self._grpc_servers.append(server)
         self.grpc_port = port
 
+    def _start_http_api(self, addr: str):
+        """Ops HTTP listener (handlers.go): healthchecks + the legacy
+        POST /import path, which feeds the same Combine machinery as
+        gRPC import."""
+        from .cluster.importsrv import ImportedMetric
+        from .http_api import HttpApi
+
+        nq = len(self.worker_queues)
+
+        def submit(digest, pb):
+            try:
+                self.worker_queues[digest % nq].put_nowait(
+                    ImportedMetric(pb))
+            except queue.Full:
+                with self._stats_lock:
+                    self.queue_drops += 1
+
+        self.http_api = HttpApi(addr, submit=submit)
+        self.http_api.start()
+
     def bound_port(self) -> int:
         """Port of the first UDP socket (for tests binding port 0)."""
         return self._sockets[0].getsockname()[1]
@@ -423,16 +452,14 @@ class Server:
         """[HOT LOOP 1] recvfrom -> split -> parse -> route
         (Server.ReadMetricSocket + HandleMetricPacket)."""
         max_len = self.cfg.metric_max_length
-        nq = len(self.worker_queues)
         while not self._stop.is_set():
             try:
                 data, _ = sock.recvfrom(max_len)
             except OSError:
                 break
-            self.handle_packet(data, nq)
+            self.handle_packet(data)
 
-    def handle_packet(self, data: bytes, nq: int | None = None):
-        nq = nq or len(self.worker_queues)
+    def handle_packet(self, data: bytes):
         with self._stats_lock:
             self.packets_received += 1
         for line in data.split(b"\n"):
@@ -444,17 +471,7 @@ class Server:
                 with self._stats_lock:
                     self.parse_errors += 1
                 continue
-            if isinstance(item, parser.UDPMetric):
-                qi = item.digest % nq
-            else:
-                qi = 0
-            try:
-                self.worker_queues[qi].put_nowait(item)
-            except queue.Full:
-                # Deliberate lossiness under backpressure, counted —
-                # veneur drops on full worker channels the same way.
-                with self._stats_lock:
-                    self.queue_drops += 1
+            self._route_metric(item)
 
     def _worker_loop(self, idx: int, q: queue.Queue):
         """[HOT LOOP 2] queue -> engine (Worker.Work +

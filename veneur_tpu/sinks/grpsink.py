@@ -79,7 +79,9 @@ class GrpcSpanSink(SpanSink):
             try:
                 self._send(span, timeout=self.timeout_s)
                 self.sent_total += 1
-            except grpc.RpcError as e:
+            except Exception as e:
+                # never let the sender thread die — a dead thread would
+                # silently disable the sink for the process lifetime
                 self.dropped_total += 1
                 log.debug("grpsink send failed: %s", e)
 

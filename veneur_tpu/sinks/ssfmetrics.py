@@ -14,6 +14,7 @@ from __future__ import annotations
 from . import SpanSink
 from ..ingest.parser import (GLOBAL_ONLY, LOCAL_ONLY, MIXED_SCOPE,
                              MetricKey, ServiceCheck, UDPMetric)
+from ..ssf import TIME_UNITS
 from ..ssf.protos import ssf_pb2
 from ..utils.hashing import metric_digest
 
@@ -30,7 +31,8 @@ _SSF_SCOPE = {
     ssf_pb2.SSFSample.GLOBAL: GLOBAL_ONLY,
 }
 
-_TIME_SCALE_NS = {"ns": 1.0, "µs": 1e3, "us": 1e3, "ms": 1e6, "s": 1e9}
+# derived from the client-side table so the unit set lives in one place
+_TIME_SCALE_NS = {u: s * 1e9 for u, s in TIME_UNITS.items()}
 
 
 def sample_to_check(s: ssf_pb2.SSFSample) -> ServiceCheck | None:

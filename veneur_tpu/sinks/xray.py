@@ -47,8 +47,10 @@ def span_to_segment(span) -> dict:
 class XRaySpanSink(SpanSink):
     def __init__(self, daemon_address: str = "127.0.0.1:2000"):
         host, _, port = daemon_address.rpartition(":")
-        self._dest = (host or "127.0.0.1", int(port))
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        host = host.strip("[]") or "127.0.0.1"
+        self._dest = (host, int(port))
+        family = socket.AF_INET6 if ":" in host else socket.AF_INET
+        self._sock = socket.socket(family, socket.SOCK_DGRAM)
         self.sent_total = 0
         self.dropped_total = 0
 

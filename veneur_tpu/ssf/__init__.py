@@ -26,12 +26,15 @@ MICROSECOND = "µs"
 MILLISECOND = "ms"
 SECOND = "s"
 
-_TIME_UNITS = {
+# unit string → seconds; "us" is an accepted ASCII alias for µs
+TIME_UNITS = {
     NANOSECOND: 1e-9,
     MICROSECOND: 1e-6,
+    "us": 1e-6,
     MILLISECOND: 1e-3,
     SECOND: 1.0,
 }
+_TIME_UNITS = TIME_UNITS
 
 
 def _mk(metric, name: str, value: float, tags=None, unit: str = "",

@@ -104,8 +104,10 @@ class Client:
                  flush_interval_s: float = 0.0):
         u = urlparse(addr if "://" in addr else f"udp://{addr}")
         if u.scheme in ("udp", ""):
-            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            self._dest = (u.hostname or "127.0.0.1", u.port or 8128)
+            host = u.hostname or "127.0.0.1"
+            family = socket.AF_INET6 if ":" in host else socket.AF_INET
+            self._sock = socket.socket(family, socket.SOCK_DGRAM)
+            self._dest = (host, u.port or 8128)
         elif u.scheme == "unix":
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
             self._dest = u.path
