@@ -75,6 +75,34 @@ def test_emit_command_timing():
     sock.close()
 
 
+def test_emit_tcp_sends_payload():
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    lsock.settimeout(5.0)
+    port = lsock.getsockname()[1]
+    got = []
+
+    def accept():
+        conn, _ = lsock.accept()
+        with conn:
+            conn.settimeout(5.0)
+            while True:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                got.append(chunk)
+
+    t = threading.Thread(target=accept, daemon=True)
+    t.start()
+    rc = emit_cli.main(["-hostport", f"tcp://127.0.0.1:{port}",
+                        "-name", "tcp.count", "-count", "4"])
+    t.join(5.0)
+    lsock.close()
+    assert rc == 0
+    assert b"".join(got) == b"tcp.count:4.0|c"
+
+
 EXPO_1 = """\
 # HELP http_requests_total Total requests.
 # TYPE http_requests_total counter
@@ -129,6 +157,29 @@ def test_prometheus_counter_deltas():
     # unchanged counter (code=500) suppressed; changed bucket emits
     assert b"code:500" not in text2
     assert b"req_latency_bucket:15.0|c|#le:+Inf" in text2
+    # histogram _sum is cumulative: delta-ed like _count, never a gauge
+    assert b"req_latency_sum" not in text1
+    assert b"req_latency_sum:" not in text2  # unchanged -> suppressed
+
+
+def test_prometheus_sum_delta_and_brace_labels():
+    prev = {}
+    expo_a = ("# TYPE lat histogram\n"
+              "lat_sum 10.0\nlat_count 4\n"
+              'errs{path="/a}b"} 3\n')
+    expo_b = ("# TYPE lat histogram\n"
+              "lat_sum 16.5\nlat_count 6\n"
+              'errs{path="/a}b"} 3\n')
+    prom_cli.to_statsd_lines(prom_cli.parse_exposition(expo_a), prev)
+    lines = prom_cli.to_statsd_lines(prom_cli.parse_exposition(expo_b),
+                                     prev)
+    text = b"\n".join(lines)
+    assert b"lat_sum:6.5|c" in text
+    assert b"lat_count:2.0|c" in text
+    # an unescaped '}' inside a quoted label value is legal exposition
+    samples = prom_cli.parse_exposition(expo_a)
+    errs = [s for s in samples if s[0] == "errs"]
+    assert errs and errs[0][1] == {"path": "/a}b"}
 
 
 def test_prometheus_end_to_end_poll():
@@ -163,11 +214,19 @@ def test_prometheus_end_to_end_poll():
 def test_proxy_cli_static_config(tmp_path):
     from veneur_tpu.cli import proxy as proxy_cli
 
-    cfgfile = tmp_path / "proxy.yaml"
-    cfgfile.write_text("""
-grpc_address: "127.0.0.1:0"
-forward_destinations: ["127.0.0.1:9999"]
-""")
+    # happy path: static destinations, Go-style refresh duration
+    proxy = proxy_cli.proxy_from_config({
+        "grpc_address": "127.0.0.1:0",
+        "forward_destinations": ["127.0.0.1:9999", "127.0.0.1:9998"],
+        "consul_refresh_interval": "1m",
+    })
+    try:
+        assert len(proxy.ring) == 2
+        assert proxy.ring.get(b"some.metric|c|") in (
+            "127.0.0.1:9998", "127.0.0.1:9999")
+        assert proxy.refresh_interval_s == 60.0
+    finally:
+        proxy.stop()
     # config missing both discovery modes errors out
     bad = tmp_path / "bad.yaml"
     bad.write_text("grpc_address: '127.0.0.1:0'\n")

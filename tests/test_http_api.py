@@ -3,7 +3,6 @@ JSON /import path — a full two-tier local→global flow over loopback HTTP
 (the handlers_global.go / flusher_test.go strategy)."""
 
 import json
-import time
 import urllib.error
 import urllib.request
 
@@ -87,7 +86,7 @@ def test_http_import_two_tier():
         for srv in locals_:
             srv.flush_once()
         # global side: wait for import queue to drain, then flush
-        time.sleep(0.5)
+        assert glob.drain(timeout=10.0)
         glob.flush_once()
         by_name = {m.name: m.value for m in gsink.all_metrics}
         assert by_name.get("fwd.timer.count") == pytest.approx(4000)
@@ -137,7 +136,7 @@ def test_import_counter_and_set_roundtrip():
                 m = parser.parse_metric(line)
                 srv.engines[m.digest % len(srv.engines)].process(m)
         srv.flush_once()
-        time.sleep(0.5)
+        assert glob.drain(timeout=10.0)
         glob.flush_once()
         by_name = {m.name: m.value for m in gsink.all_metrics}
         assert by_name.get("fwd.gcount") == pytest.approx(200)

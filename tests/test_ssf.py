@@ -176,11 +176,9 @@ def _wait(pred, timeout=5.0):
 
 
 def _drain_and_flush(srv):
-    """Wait for the span worker + metric workers to drain, then flush —
-    the ingest path is asynchronous end to end."""
-    _wait(lambda: srv.span_queue.empty())
-    _wait(lambda: all(q.empty() for q in srv.worker_queues))
-    time.sleep(0.1)   # let in-flight items reach the engines
+    """Wait for the span worker + metric workers to fully process every
+    in-flight item (Server.drain is deterministic), then flush."""
+    assert srv.drain(timeout=10.0)
     srv.flush_once()
 
 

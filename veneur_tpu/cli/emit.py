@@ -53,8 +53,10 @@ def build_ssf_span(args):
 def send_payload(hostport: str, payload: bytes):
     u = urlparse(hostport if "://" in hostport else f"udp://{hostport}")
     if u.scheme in ("udp", ""):
-        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        sock.sendto(payload, (u.hostname or "127.0.0.1", u.port or 8125))
+        host = u.hostname or "127.0.0.1"
+        family = socket.AF_INET6 if ":" in host else socket.AF_INET
+        sock = socket.socket(family, socket.SOCK_DGRAM)
+        sock.sendto(payload, (host, u.port or 8125))
         sock.close()
     elif u.scheme == "unix":
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
@@ -62,8 +64,9 @@ def send_payload(hostport: str, payload: bytes):
         sock.close()
     elif u.scheme == "tcp":
         with socket.create_connection(
-                (u.hostname or "127.0.0.1", u.port or 8125), timeout=5):
-            pass
+                (u.hostname or "127.0.0.1", u.port or 8125),
+                timeout=5) as sock:
+            sock.sendall(payload)
     else:
         raise ValueError(f"unsupported scheme {u.scheme!r}")
 

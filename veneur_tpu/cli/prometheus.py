@@ -20,9 +20,12 @@ import urllib.request
 
 log = logging.getLogger("veneur-prometheus")
 
+# the label body must be matched as a sequence of quoted values, not
+# [^}]* — an unescaped '}' is legal inside a quoted label value
 _LINE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?\s+"
+    r'(?:\{(?P<labels>(?:\s*[a-zA-Z_][a-zA-Z0-9_]*\s*=\s*'
+    r'"(?:[^"\\]|\\.)*"\s*,?)*)\})?\s+'
     r"(?P<value>[^\s]+)(?:\s+\d+)?$")
 _LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
@@ -73,7 +76,7 @@ def to_statsd_lines(samples, prev: dict, prefix: str = "",
         key = (name, tagstr)
         mname = prefix + name
         if ftype in ("counter", "histogram", "summary") and (
-                name.endswith(("_total", "_count", "_bucket"))
+                name.endswith(("_total", "_count", "_bucket", "_sum"))
                 or ftype == "counter"):
             last = prev.get(key)
             prev[key] = value
@@ -113,8 +116,14 @@ def main(argv=None) -> int:
 
     logging.basicConfig(level=logging.INFO)
     host, _, port = args.statsd_host.rpartition(":")
+    host = host.strip("[]")
+    if not port.isdigit():
+        print(f"-s must be host:port, got {args.statsd_host!r}",
+              file=sys.stderr)
+        return 1
     dest = (host or "127.0.0.1", int(port))
-    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    family = socket.AF_INET6 if ":" in dest[0] else socket.AF_INET
+    sock = socket.socket(family, socket.SOCK_DGRAM)
 
     prev: dict = {}
     n_polls = 0

@@ -18,6 +18,35 @@ import threading
 import yaml
 
 
+def proxy_from_config(cfg: dict):
+    """Build and start a ProxyServer from proxy YAML keys. Returns the
+    running proxy, or raises ValueError on an unusable config."""
+    from ..cluster.discovery import ConsulDiscoverer, StaticDiscoverer
+    from ..cluster.proxy import ProxyServer
+    from ..config import _parse_interval
+
+    service = cfg.get("consul_forward_service_name", "")
+    if service:
+        disc = ConsulDiscoverer(
+            cfg.get("consul_url", "http://127.0.0.1:8500"))
+    else:
+        static = cfg.get("forward_destinations", [])
+        if not static:
+            raise ValueError(
+                "proxy config needs consul_forward_service_name or "
+                "forward_destinations")
+        disc = StaticDiscoverer(static)
+
+    refresh = _parse_interval(cfg.get("consul_refresh_interval", "30s"))
+    proxy = ProxyServer(disc, service_name=service,
+                        refresh_interval_s=refresh)
+    addr = cfg.get("grpc_address", "0.0.0.0:8128")
+    proxy.start(addr)
+    logging.getLogger("veneur-proxy").info(
+        "proxying on %s -> %d destinations", addr, len(proxy.ring))
+    return proxy
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="veneur-proxy")
     ap.add_argument("-f", dest="config", required=True,
@@ -32,28 +61,11 @@ def main(argv=None) -> int:
     with open(args.config) as f:
         cfg = yaml.safe_load(f) or {}
 
-    from ..cluster.discovery import ConsulDiscoverer, StaticDiscoverer
-    from ..cluster.proxy import ProxyServer
-
-    service = cfg.get("consul_forward_service_name", "")
-    if service:
-        disc = ConsulDiscoverer(
-            cfg.get("consul_url", "http://127.0.0.1:8500"))
-    else:
-        static = cfg.get("forward_destinations", [])
-        if not static:
-            print("proxy config needs consul_forward_service_name or "
-                  "forward_destinations", file=sys.stderr)
-            return 1
-        disc = StaticDiscoverer(static)
-
-    refresh = float(str(cfg.get("consul_refresh_interval", "30")).rstrip("s"))
-    proxy = ProxyServer(disc, service_name=service,
-                        refresh_interval_s=refresh)
-    addr = cfg.get("grpc_address", "0.0.0.0:8128")
-    proxy.start(addr)
-    logging.getLogger("veneur-proxy").info(
-        "proxying on %s -> %d destinations", addr, len(proxy.ring))
+    try:
+        proxy = proxy_from_config(cfg)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

@@ -122,17 +122,26 @@ class HttpApi:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n))
-                    count = 0
+                    if not isinstance(body, list):
+                        raise ValueError("body must be a JSON array "
+                                         "of metrics")
+                    # decode the whole batch before submitting any of it
+                    # (atomic like handleImport: a 400 means nothing was
+                    # imported, so clients may safely re-send)
+                    decoded = []
                     for d in body:
                         pb = json_metric_to_pb(d)
                         key = wire.metric_key_of(pb)
                         digest = metric_digest(key.name, key.type,
                                                key.joined_tags)
-                        api._submit(digest, pb)
-                        count += 1
+                        decoded.append((digest, pb))
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply(400, f"bad import body: {e}\n".encode())
                     return
+                count = 0
+                for digest, pb in decoded:
+                    api._submit(digest, pb)
+                    count += 1
                 self._reply(200, json.dumps({"imported": count}).encode(),
                             "application/json")
 

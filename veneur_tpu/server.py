@@ -372,25 +372,31 @@ class Server:
     def handle_ssf_span(self, span):
         """Route one ingested span to the SpanWorker (drop-on-full,
         counted, like the reference's SpanChan)."""
-        with self._stats_lock:
-            self.spans_received += 1
         try:
             self.span_queue.put_nowait(span)
         except queue.Full:
             with self._stats_lock:
                 self.queue_drops += 1
+        # counted after the enqueue so a waiter that observes the count
+        # and then drain()s cannot race ahead of the item
+        with self._stats_lock:
+            self.spans_received += 1
 
     def _span_worker(self):
         """SpanWorker: fan each span out to every span sink."""
         while True:
             span = self.span_queue.get()
-            if span is _STOP:
-                break
-            for ss in self.span_sinks:
-                try:
-                    ss.ingest(span)
-                except Exception:
-                    log.exception("span sink %s ingest failed", ss.name())
+            try:
+                if span is _STOP:
+                    break
+                for ss in self.span_sinks:
+                    try:
+                        ss.ingest(span)
+                    except Exception:
+                        log.exception("span sink %s ingest failed",
+                                      ss.name())
+            finally:
+                self.span_queue.task_done()
 
     def _route_metric(self, item):
         """Digest-route one item onto a worker queue — the single
@@ -460,8 +466,6 @@ class Server:
             self.handle_packet(data)
 
     def handle_packet(self, data: bytes):
-        with self._stats_lock:
-            self.packets_received += 1
         for line in data.split(b"\n"):
             if not line:
                 continue
@@ -472,6 +476,10 @@ class Server:
                     self.parse_errors += 1
                 continue
             self._route_metric(item)
+        # counted after routing so a waiter that observes the count and
+        # then drain()s cannot race ahead of the lines
+        with self._stats_lock:
+            self.packets_received += 1
 
     def _worker_loop(self, idx: int, q: queue.Queue):
         """[HOT LOOP 2] queue -> engine (Worker.Work +
@@ -482,16 +490,34 @@ class Server:
         eng = self.engines[idx]
         while True:
             item = q.get()
-            if item is _STOP:
-                break
-            if isinstance(item, parser.UDPMetric):
-                eng.process(item)
-            elif isinstance(item, ImportedMetric):
-                apply_metric_to_engine(eng, item.pb)
-            elif isinstance(item, parser.Event):
-                eng.process_event(item)
-            else:
-                eng.process_service_check(item)
+            try:
+                if item is _STOP:
+                    break
+                if isinstance(item, parser.UDPMetric):
+                    eng.process(item)
+                elif isinstance(item, ImportedMetric):
+                    apply_metric_to_engine(eng, item.pb)
+                elif isinstance(item, parser.Event):
+                    eng.process_event(item)
+                else:
+                    eng.process_service_check(item)
+            finally:
+                q.task_done()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until every enqueued span and metric has been fully
+        processed by its worker (not merely popped). Deterministic
+        replacement for sleep-based settling in tests: uses the queues'
+        unfinished-task accounting, so an item mid-`eng.process` still
+        counts as in flight."""
+        deadline = time.monotonic() + timeout
+        queues = [self.span_queue] + self.worker_queues
+        while True:
+            if all(q.unfinished_tasks == 0 for q in queues):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
 
     # ------------- flush -------------
 
