@@ -1,0 +1,976 @@
+// vtpu_ingest — native high-rate DogStatsD ingest bridge.
+//
+// The TPU-native analogue of veneur's ingest front half
+// (server.go sym: Server.ReadMetricSocket, Server.HandleMetricPacket;
+// samplers/parser.go sym: ParseMetric; worker.go sym: Worker.ProcessMetric's
+// dispatch-by-digest): SO_REUSEPORT UDP reader threads, a byte-level
+// DogStatsD parser, a sharded MetricKey-interning hash table assigning
+// device bank slots, and per-bank sample rings that the Python pump drains
+// into fixed-shape batches for the XLA scatter kernels.
+//
+// Conformance contract: for every line this parser accepts, the produced
+// (name, type, joined_tags, digest, value, rate, scope) must be
+// bit-identical with veneur_tpu/ingest/parser.py. Lines it cannot prove
+// bit-identical handling for (events, service checks, invalid UTF-8,
+// numeric tokens with '_' or whitespace that CPython's float() would
+// accept) are routed to the "other" queue for the Python slow path
+// instead of being guessed at.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- constants
+
+enum Bank : int { B_HISTO = 0, B_COUNTER = 1, B_GAUGE = 2, B_SET = 3 };
+constexpr int NUM_BANKS = 4;
+
+enum MType : uint8_t {
+  MT_COUNTER = 0,
+  MT_GAUGE = 1,
+  MT_TIMER = 2,
+  MT_HISTOGRAM = 3,
+  MT_SET = 4,
+};
+
+// Scope values match ingest/parser.py MIXED_SCOPE / LOCAL_ONLY / GLOBAL_ONLY.
+enum Scope : uint8_t { SC_MIXED = 0, SC_LOCAL = 1, SC_GLOBAL = 2 };
+
+constexpr int NUM_SHARDS = 16;
+
+const char* const MTYPE_NAMES[5] = {"counter", "gauge", "timer", "histogram",
+                                    "set"};
+
+inline int bank_of(MType t) {
+  switch (t) {
+    case MT_COUNTER: return B_COUNTER;
+    case MT_GAUGE: return B_GAUGE;
+    case MT_TIMER:
+    case MT_HISTOGRAM: return B_HISTO;
+    case MT_SET: return B_SET;
+  }
+  return B_HISTO;
+}
+
+// ---------------------------------------------------------------- hashing
+// FNV-1a, identical to utils/hashing.py (itself parity with the fnv32a in
+// samplers/parser.go) so proxies/tests agree about key identity.
+
+constexpr uint32_t FNV32_OFFSET = 0x811C9DC5u;
+constexpr uint32_t FNV32_PRIME = 0x01000193u;
+constexpr uint64_t FNV64_OFFSET = 0xCBF29CE484222325ull;
+constexpr uint64_t FNV64_PRIME = 0x00000100000001B3ull;
+
+inline uint32_t fnv1a_32(const uint8_t* p, size_t n, uint32_t h) {
+  for (size_t i = 0; i < n; i++) h = (h ^ p[i]) * FNV32_PRIME;
+  return h;
+}
+
+inline uint64_t fnv1a_64(const uint8_t* p, size_t n, uint64_t h) {
+  for (size_t i = 0; i < n; i++) h = (h ^ p[i]) * FNV64_PRIME;
+  return h;
+}
+
+inline uint64_t fmix64(uint64_t h) {  // murmur3 finalizer (hashing.py fmix64)
+  h ^= h >> 33;
+  h *= 0xFF51AFD7ED558CCDull;
+  h ^= h >> 33;
+  h *= 0xC4CEB9FE1A85EC53ull;
+  h ^= h >> 33;
+  return h;
+}
+
+// ---------------------------------------------------------------- utf8
+// Strict UTF-8 validation: CPython's decoder only leaves bytes unchanged
+// (decode('utf-8','replace') then re-encode) when the input is strictly
+// valid, so "strictly valid" is exactly the fast-path condition.
+
+bool utf8_valid(const uint8_t* s, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    uint8_t b = s[i];
+    if (b < 0x80) {
+      i++;
+    } else if ((b >> 5) == 0x6) {  // 110xxxxx
+      if (b < 0xC2 || i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return false;
+      i += 2;
+    } else if ((b >> 4) == 0xE) {  // 1110xxxx
+      if (i + 2 >= n) return false;
+      uint8_t b1 = s[i + 1], b2 = s[i + 2];
+      if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80) return false;
+      if (b == 0xE0 && b1 < 0xA0) return false;        // overlong
+      if (b == 0xED && b1 > 0x9F) return false;        // surrogates
+      i += 3;
+    } else if ((b >> 3) == 0x1E) {  // 11110xxx
+      if (b > 0xF4 || i + 3 >= n) return false;
+      uint8_t b1 = s[i + 1], b2 = s[i + 2], b3 = s[i + 3];
+      if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80 ||
+          (b3 & 0xC0) != 0x80)
+        return false;
+      if (b == 0xF0 && b1 < 0x90) return false;        // overlong
+      if (b == 0xF4 && b1 > 0x8F) return false;        // > U+10FFFF
+      i += 4;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------- numbers
+// CPython float() compatibility triage for a numeric token:
+//   OK     — strtod agrees with float() (charset-restricted decimal forms)
+//   ERROR  — float() would raise (both sides reject)
+//   SLOW   — float() may accept forms strtod can't ('_' digit grouping,
+//            exotic whitespace trimming) → route the line to Python.
+
+enum NumVerdict { NUM_OK = 0, NUM_ERROR = 1, NUM_SLOW = 2 };
+
+NumVerdict parse_pyfloat(const uint8_t* p, size_t n, double* out) {
+  if (n == 0) return NUM_ERROR;
+  for (size_t i = 0; i < n; i++) {
+    uint8_t c = p[i];
+    if ((c >= '0' && c <= '9') || c == '+' || c == '-' || c == '.' ||
+        c == 'e' || c == 'E')
+      continue;
+    if (c == '_' || c == ' ' || (c >= 0x09 && c <= 0x0D)) return NUM_SLOW;
+    return NUM_ERROR;  // 'x', 'p', letters, NUL, UTF-8 ws… float() raises too
+  }
+  char buf[64];
+  if (n >= sizeof(buf)) return NUM_SLOW;  // absurd token; let Python decide
+  memcpy(buf, p, n);
+  buf[n] = '\0';
+  char* end = nullptr;
+  errno = 0;
+  double v = strtod(buf, &end);
+  if (end != buf + n) return NUM_ERROR;  // e.g. "1e", "--1", "."
+  *out = v;                              // may be ±inf on overflow, like float()
+  return NUM_OK;
+}
+
+// ---------------------------------------------------------------- parser
+
+enum ParseVerdict {
+  P_METRIC = 0,   // parsed a metric sample
+  P_ERROR = 1,    // ParseError on both implementations
+  P_OTHER = 2,    // event / service check / slow path → Python
+};
+
+struct ParsedMetric {
+  MType mtype;
+  uint8_t scope;
+  double value;        // numeric types
+  double rate;
+  uint32_t digest;
+  std::string name;        // raw bytes (validated UTF-8)
+  std::string joined_tags; // sorted, comma-joined
+  std::string member;      // set member bytes
+};
+
+// Parse one line. `scratch` vectors are caller-provided to avoid per-line
+// allocation on the hot path.
+ParseVerdict parse_line(const uint8_t* data, size_t len, ParsedMetric* m,
+                        std::vector<std::pair<const uint8_t*, size_t>>* secs,
+                        std::vector<std::pair<const uint8_t*, size_t>>* tags) {
+  if (len == 0) return P_ERROR;
+  if (len >= 3 && memcmp(data, "_e{", 3) == 0) return P_OTHER;
+  if (len >= 4 && memcmp(data, "_sc|", 4) == 0) return P_OTHER;
+  if (!utf8_valid(data, len)) return P_OTHER;  // replace-decode divergence
+
+  const uint8_t* colon =
+      static_cast<const uint8_t*>(memchr(data, ':', len));
+  if (colon == nullptr || colon == data) return P_ERROR;
+  const uint8_t* name = data;
+  size_t name_len = static_cast<size_t>(colon - data);
+  const uint8_t* rest = colon + 1;
+  size_t rest_len = len - name_len - 1;
+
+  // split rest on '|'
+  secs->clear();
+  {
+    const uint8_t* p = rest;
+    size_t remain = rest_len;
+    for (;;) {
+      const uint8_t* bar =
+          static_cast<const uint8_t*>(memchr(p, '|', remain));
+      if (bar == nullptr) {
+        secs->emplace_back(p, remain);
+        break;
+      }
+      secs->emplace_back(p, static_cast<size_t>(bar - p));
+      remain -= static_cast<size_t>(bar - p) + 1;
+      p = bar + 1;
+    }
+  }
+  if (secs->size() < 2) return P_ERROR;  // missing type
+
+  const uint8_t* valstr = (*secs)[0].first;
+  size_t val_len = (*secs)[0].second;
+  const uint8_t* typestr = (*secs)[1].first;
+  size_t type_len = (*secs)[1].second;
+
+  MType mtype;
+  bool is_dist = false;
+  if (type_len == 1) {
+    switch (typestr[0]) {
+      case 'c': mtype = MT_COUNTER; break;
+      case 'g': mtype = MT_GAUGE; break;
+      case 'h': mtype = MT_HISTOGRAM; break;
+      case 's': mtype = MT_SET; break;
+      case 'd': mtype = MT_HISTOGRAM; is_dist = true; break;
+      default: return P_ERROR;
+    }
+  } else if (type_len == 2 && typestr[0] == 'm' && typestr[1] == 's') {
+    mtype = MT_TIMER;
+  } else {
+    return P_ERROR;
+  }
+
+  double value = 0.0;
+  if (mtype == MT_SET) {
+    m->member.assign(reinterpret_cast<const char*>(valstr), val_len);
+  } else {
+    if (val_len == 0) return P_ERROR;
+    NumVerdict nv = parse_pyfloat(valstr, val_len, &value);
+    if (nv == NUM_SLOW) return P_OTHER;
+    if (nv == NUM_ERROR) return P_ERROR;
+    if (!std::isfinite(value)) return P_ERROR;
+  }
+
+  double rate = 1.0;
+  uint8_t scope = is_dist ? SC_GLOBAL : SC_MIXED;
+  bool seen_rate = false, seen_tags = false;
+  tags->clear();
+
+  for (size_t si = 2; si < secs->size(); si++) {
+    const uint8_t* sec = (*secs)[si].first;
+    size_t sec_len = (*secs)[si].second;
+    if (sec_len == 0) return P_ERROR;
+    if (sec[0] == '@') {
+      if (seen_rate) return P_ERROR;
+      seen_rate = true;
+      NumVerdict nv = parse_pyfloat(sec + 1, sec_len - 1, &rate);
+      if (nv == NUM_SLOW) return P_OTHER;
+      if (nv == NUM_ERROR) return P_ERROR;
+      if (!(rate > 0.0 && rate <= 1.0)) return P_ERROR;
+      if ((mtype == MT_GAUGE || mtype == MT_SET) && rate != 1.0)
+        return P_ERROR;
+    } else if (sec[0] == '#') {
+      if (seen_tags) return P_ERROR;
+      seen_tags = true;
+      const uint8_t* p = sec + 1;
+      size_t remain = sec_len - 1;
+      for (;;) {
+        const uint8_t* comma =
+            remain ? static_cast<const uint8_t*>(memchr(p, ',', remain))
+                   : nullptr;
+        size_t tlen = comma ? static_cast<size_t>(comma - p) : remain;
+        if (tlen == 15 && memcmp(p, "veneurlocalonly", 15) == 0) {
+          scope = SC_LOCAL;
+        } else if (tlen == 16 && memcmp(p, "veneurglobalonly", 16) == 0) {
+          scope = SC_GLOBAL;
+        } else if (tlen > 0) {
+          tags->emplace_back(p, tlen);
+        }
+        if (!comma) break;
+        remain -= tlen + 1;
+        p = comma + 1;
+      }
+      // byte-wise sort == code-point sort for valid UTF-8
+      std::sort(tags->begin(), tags->end(),
+                [](const std::pair<const uint8_t*, size_t>& a,
+                   const std::pair<const uint8_t*, size_t>& b) {
+                  int c = memcmp(a.first, b.first,
+                                 a.second < b.second ? a.second : b.second);
+                  if (c != 0) return c < 0;
+                  return a.second < b.second;
+                });
+    } else {
+      return P_ERROR;
+    }
+  }
+
+  if (name_len == 0) return P_ERROR;
+
+  m->mtype = mtype;
+  m->scope = scope;
+  m->value = value;
+  m->rate = rate;
+  m->name.assign(reinterpret_cast<const char*>(name), name_len);
+  m->joined_tags.clear();
+  for (size_t i = 0; i < tags->size(); i++) {
+    if (i) m->joined_tags.push_back(',');
+    m->joined_tags.append(reinterpret_cast<const char*>((*tags)[i].first),
+                          (*tags)[i].second);
+  }
+
+  uint32_t h = fnv1a_32(name, name_len, FNV32_OFFSET);
+  const char* tn = MTYPE_NAMES[mtype];
+  h = fnv1a_32(reinterpret_cast<const uint8_t*>(tn), strlen(tn), h);
+  h = fnv1a_32(
+      reinterpret_cast<const uint8_t*>(m->joined_tags.data()),
+      m->joined_tags.size(), h);
+  m->digest = h;
+  return P_METRIC;
+}
+
+// ---------------------------------------------------------------- rings
+
+struct Ring {
+  std::mutex mu;
+  std::vector<int32_t> slots;
+  std::vector<float> a;
+  std::vector<float> b;
+  std::vector<int32_t> c;
+  size_t cap = 0, head = 0, count = 0;
+  uint64_t drops = 0;
+
+  void init(size_t capacity) {
+    cap = capacity;
+    slots.resize(cap);
+    a.resize(cap);
+    b.resize(cap);
+    c.resize(cap);
+  }
+
+  // bulk append; drops (and counts) what doesn't fit — veneur's
+  // full-worker-channel backpressure drop, not blocking.
+  void push(const int32_t* s, const float* av, const float* bv,
+            const int32_t* cv, size_t n) {
+    std::lock_guard<std::mutex> g(mu);
+    size_t space = cap - count;
+    if (n > space) {
+      drops += n - space;
+      n = space;
+    }
+    size_t tail = (head + count) % cap;
+    size_t first = std::min(n, cap - tail);
+    memcpy(&slots[tail], s, first * sizeof(int32_t));
+    memcpy(&a[tail], av, first * sizeof(float));
+    memcpy(&b[tail], bv, first * sizeof(float));
+    memcpy(&c[tail], cv, first * sizeof(int32_t));
+    if (n > first) {
+      memcpy(&slots[0], s + first, (n - first) * sizeof(int32_t));
+      memcpy(&a[0], av + first, (n - first) * sizeof(float));
+      memcpy(&b[0], bv + first, (n - first) * sizeof(float));
+      memcpy(&c[0], cv + first, (n - first) * sizeof(int32_t));
+    }
+    count += n;
+  }
+
+  size_t pop(int32_t* s, float* av, float* bv, int32_t* cv, size_t max_n) {
+    std::lock_guard<std::mutex> g(mu);
+    size_t n = std::min(count, max_n);
+    size_t first = std::min(n, cap - head);
+    memcpy(s, &slots[head], first * sizeof(int32_t));
+    memcpy(av, &a[head], first * sizeof(float));
+    memcpy(bv, &b[head], first * sizeof(float));
+    memcpy(cv, &c[head], first * sizeof(int32_t));
+    if (n > first) {
+      memcpy(s + first, &slots[0], (n - first) * sizeof(int32_t));
+      memcpy(av + first, &a[0], (n - first) * sizeof(float));
+      memcpy(bv + first, &b[0], (n - first) * sizeof(float));
+      memcpy(cv + first, &c[0], (n - first) * sizeof(int32_t));
+    }
+    head = (head + n) % cap;
+    count -= n;
+    return n;
+  }
+};
+
+// ---------------------------------------------------------------- interner
+
+struct NewKey {
+  uint8_t bank, mtype, scope;
+  int32_t slot;
+  std::string name, tags;
+};
+
+struct Shard {
+  std::mutex mu;
+  // key string: name '\x1f' type-name '\x1f' joined_tags
+  std::unordered_map<std::string, int32_t> map[NUM_BANKS];
+};
+
+struct BankMeta {
+  int32_t capacity = 0;
+  std::vector<std::atomic<uint32_t>> last_interval;
+  std::vector<std::atomic<uint8_t>> scope;
+  std::mutex free_mu;
+  std::vector<int32_t> free_slots;
+  std::atomic<uint32_t> interval{0};
+  std::atomic<uint64_t> drops_no_slot{0};
+  std::atomic<int64_t> key_count{0};
+
+  void init(int32_t cap) {
+    capacity = cap;
+    last_interval = std::vector<std::atomic<uint32_t>>(cap);
+    scope = std::vector<std::atomic<uint8_t>>(cap);
+    for (int32_t i = 0; i < cap; i++) {
+      last_interval[i].store(0, std::memory_order_relaxed);
+      scope[i].store(0, std::memory_order_relaxed);
+    }
+    free_slots.reserve(cap);
+    for (int32_t i = cap - 1; i >= 0; i--) free_slots.push_back(i);
+  }
+};
+
+// ---------------------------------------------------------------- bridge
+
+struct Bridge {
+  BankMeta banks[NUM_BANKS];
+  Shard shards[NUM_SHARDS];
+  Ring rings[NUM_BANKS];
+  int hll_precision = 14;
+  int idle_ttl = 16;
+
+  std::mutex newkeys_mu;
+  std::deque<NewKey> newkeys;
+
+  std::mutex other_mu;
+  std::deque<std::string> other;
+  size_t other_cap = 65536;
+  uint64_t other_drops = 0;
+
+  std::atomic<uint64_t> packets{0}, lines{0}, samples{0}, parse_errors{0},
+      slow_routed{0};
+
+  std::vector<int> socks;
+  std::vector<std::thread> readers;
+  std::atomic<bool> stop{false};
+  int bound_port = 0;
+  int max_packet = 8192;
+};
+
+// per-thread parse + staging state
+struct LocalStage {
+  std::vector<std::pair<const uint8_t*, size_t>> secs, tags;
+  ParsedMetric m;
+  std::string keybuf;
+  std::vector<int32_t> slots[NUM_BANKS];
+  std::vector<float> a[NUM_BANKS];
+  std::vector<float> b[NUM_BANKS];
+  std::vector<int32_t> c[NUM_BANKS];
+
+  void flush(Bridge* br) {
+    for (int bk = 0; bk < NUM_BANKS; bk++) {
+      if (!slots[bk].empty()) {
+        br->rings[bk].push(slots[bk].data(), a[bk].data(), b[bk].data(),
+                           c[bk].data(), slots[bk].size());
+        slots[bk].clear();
+        a[bk].clear();
+        b[bk].clear();
+        c[bk].clear();
+      }
+    }
+  }
+};
+
+int32_t intern_key(Bridge* br, const ParsedMetric& m, std::string* keybuf) {
+  int bk = bank_of(m.mtype);
+  BankMeta& bank = br->banks[bk];
+  Shard& sh = br->shards[m.digest & (NUM_SHARDS - 1)];
+  keybuf->clear();
+  keybuf->append(m.name);
+  keybuf->push_back('\x1f');
+  keybuf->append(MTYPE_NAMES[m.mtype]);
+  keybuf->push_back('\x1f');
+  keybuf->append(m.joined_tags);
+
+  int32_t slot;
+  {
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.map[bk].find(*keybuf);
+    if (it != sh.map[bk].end()) {
+      slot = it->second;
+    } else {
+      {
+        std::lock_guard<std::mutex> fg(bank.free_mu);
+        if (bank.free_slots.empty()) {
+          bank.drops_no_slot.fetch_add(1, std::memory_order_relaxed);
+          return -1;
+        }
+        slot = bank.free_slots.back();
+        bank.free_slots.pop_back();
+      }
+      sh.map[bk].emplace(*keybuf, slot);
+      bank.key_count.fetch_add(1, std::memory_order_relaxed);
+      NewKey nk;
+      nk.bank = static_cast<uint8_t>(bk);
+      nk.mtype = static_cast<uint8_t>(m.mtype);
+      nk.scope = m.scope;
+      nk.slot = slot;
+      nk.name = m.name;
+      nk.tags = m.joined_tags;
+      std::lock_guard<std::mutex> ng(br->newkeys_mu);
+      br->newkeys.push_back(std::move(nk));
+    }
+  }
+  bank.last_interval[slot].store(
+      bank.interval.load(std::memory_order_relaxed),
+      std::memory_order_relaxed);
+  bank.scope[slot].store(m.scope, std::memory_order_relaxed);
+  return slot;
+}
+
+void route_other(Bridge* br, const uint8_t* line, size_t len) {
+  std::lock_guard<std::mutex> g(br->other_mu);
+  if (br->other.size() >= br->other_cap) {
+    br->other_drops++;
+    return;
+  }
+  br->other.emplace_back(reinterpret_cast<const char*>(line), len);
+}
+
+void handle_line(Bridge* br, LocalStage* st, const uint8_t* line,
+                 size_t len) {
+  br->lines.fetch_add(1, std::memory_order_relaxed);
+  ParseVerdict v = parse_line(line, len, &st->m, &st->secs, &st->tags);
+  if (v == P_ERROR) {
+    br->parse_errors.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  if (v == P_OTHER) {
+    br->slow_routed.fetch_add(1, std::memory_order_relaxed);
+    route_other(br, line, len);
+    return;
+  }
+  const ParsedMetric& m = st->m;
+  int32_t slot = intern_key(br, m, &st->keybuf);
+  if (slot < 0) return;
+  int bk = bank_of(m.mtype);
+  br->samples.fetch_add(1, std::memory_order_relaxed);
+  switch (bk) {
+    case B_HISTO:
+    case B_COUNTER:
+      st->slots[bk].push_back(slot);
+      st->a[bk].push_back(static_cast<float>(m.value));
+      st->b[bk].push_back(static_cast<float>(1.0 / m.rate));
+      st->c[bk].push_back(0);
+      break;
+    case B_GAUGE:
+      // last-write-wins sequence numbers are assigned by the engine at
+      // dispatch time (ingest_gauge_batch), under the same lock as the
+      // flush swap — ring order is arrival order
+      st->slots[bk].push_back(slot);
+      st->a[bk].push_back(static_cast<float>(m.value));
+      st->b[bk].push_back(0.0f);
+      st->c[bk].push_back(0);
+      break;
+    case B_SET: {
+      // member hash identical to hashing.py set_member_hash + the rho
+      // computation in pipeline.py _process_locked
+      int p = br->hll_precision;
+      uint64_t h = fmix64(fnv1a_64(
+          reinterpret_cast<const uint8_t*>(m.member.data()),
+          m.member.size(), FNV64_OFFSET));
+      uint32_t idx = static_cast<uint32_t>(h >> (64 - p));
+      uint64_t rest = (h << p) | ((1ull << p) - 1);
+      int rho = __builtin_clzll(rest) + 1;
+      st->slots[bk].push_back(slot);
+      st->a[bk].push_back(static_cast<float>(rho));
+      st->b[bk].push_back(0.0f);
+      st->c[bk].push_back(static_cast<int32_t>(idx));
+      break;
+    }
+  }
+}
+
+void handle_buffer(Bridge* br, LocalStage* st, const uint8_t* data,
+                   size_t len) {
+  size_t i = 0;
+  while (i < len) {
+    const uint8_t* nl =
+        static_cast<const uint8_t*>(memchr(data + i, '\n', len - i));
+    size_t ll = nl ? static_cast<size_t>(nl - (data + i)) : len - i;
+    if (ll > 0) handle_line(br, st, data + i, ll);
+    i += ll + 1;
+  }
+}
+
+void reader_loop(Bridge* br, int sock) {
+  constexpr int VLEN = 64;
+  LocalStage st;
+  std::vector<std::vector<uint8_t>> bufs(VLEN);
+  std::vector<mmsghdr> msgs(VLEN);
+  std::vector<iovec> iovs(VLEN);
+  for (int i = 0; i < VLEN; i++) {
+    bufs[i].resize(br->max_packet);
+    iovs[i].iov_base = bufs[i].data();
+    iovs[i].iov_len = bufs[i].size();
+    memset(&msgs[i], 0, sizeof(mmsghdr));
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+  }
+  pollfd pfd{sock, POLLIN, 0};
+  while (!br->stop.load(std::memory_order_relaxed)) {
+    int pr = poll(&pfd, 1, 100);
+    if (pr <= 0) continue;
+    int n = recvmmsg(sock, msgs.data(), VLEN, MSG_DONTWAIT, nullptr);
+    if (n <= 0) continue;
+    br->packets.fetch_add(n, std::memory_order_relaxed);
+    for (int i = 0; i < n; i++)
+      handle_buffer(br, &st, bufs[i].data(), msgs[i].msg_len);
+    st.flush(br);
+  }
+}
+
+}  // namespace
+
+// ================================================================ C ABI
+
+extern "C" {
+
+void* vtpu_create(int32_t histo_slots, int32_t counter_slots,
+                  int32_t gauge_slots, int32_t set_slots,
+                  int32_t hll_precision, int32_t idle_ttl,
+                  int32_t ring_capacity, int32_t max_packet) {
+  Bridge* br = new Bridge();
+  int32_t caps[NUM_BANKS] = {histo_slots, counter_slots, gauge_slots,
+                             set_slots};
+  for (int i = 0; i < NUM_BANKS; i++) {
+    br->banks[i].init(caps[i]);
+    br->rings[i].init(static_cast<size_t>(ring_capacity));
+  }
+  br->hll_precision = hll_precision;
+  br->idle_ttl = idle_ttl;
+  br->max_packet = max_packet;
+  return br;
+}
+
+void vtpu_destroy(void* h) {
+  Bridge* br = static_cast<Bridge*>(h);
+  br->stop.store(true);
+  for (auto& t : br->readers)
+    if (t.joinable()) t.join();
+  for (int s : br->socks) close(s);
+  delete br;
+}
+
+// Feed one raw packet (possibly multiple '\n'-separated lines) from the
+// calling thread — the test/slow-path entry, same code as the readers.
+void vtpu_handle_packet(void* h, const uint8_t* data, int32_t len) {
+  Bridge* br = static_cast<Bridge*>(h);
+  thread_local LocalStage st;
+  br->packets.fetch_add(1, std::memory_order_relaxed);
+  handle_buffer(br, &st, data, static_cast<size_t>(len));
+  st.flush(br);
+}
+
+// Start n SO_REUSEPORT UDP reader threads on host:port. Returns bound
+// port (useful with port 0) or -errno.
+int32_t vtpu_start_udp(void* h, const char* host, int32_t port,
+                       int32_t n_readers, int32_t rcvbuf) {
+  Bridge* br = static_cast<Bridge*>(h);
+  bool v6 = strchr(host, ':') != nullptr;
+  int bound = -1;
+  for (int r = 0; r < n_readers; r++) {
+    int fd = socket(v6 ? AF_INET6 : AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) return -errno;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+#ifdef SO_REUSEPORT
+    setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+#endif
+    if (rcvbuf > 0)
+      setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcvbuf, sizeof(rcvbuf));
+    int rc;
+    if (v6) {
+      sockaddr_in6 sa{};
+      sa.sin6_family = AF_INET6;
+      sa.sin6_port = htons(static_cast<uint16_t>(bound > 0 ? bound : port));
+      if (inet_pton(AF_INET6, host, &sa.sin6_addr) != 1) {
+        close(fd);
+        return -EINVAL;  // hostnames must be resolved by the caller
+      }
+      rc = bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
+      if (rc == 0 && bound < 0) {
+        socklen_t sl = sizeof(sa);
+        getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &sl);
+        bound = ntohs(sa.sin6_port);
+      }
+    } else {
+      sockaddr_in sa{};
+      sa.sin_family = AF_INET;
+      sa.sin_port = htons(static_cast<uint16_t>(bound > 0 ? bound : port));
+      if (inet_pton(AF_INET, host, &sa.sin_addr) != 1) {
+        close(fd);
+        return -EINVAL;  // hostnames must be resolved by the caller
+      }
+      rc = bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
+      if (rc == 0 && bound < 0) {
+        socklen_t sl = sizeof(sa);
+        getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &sl);
+        bound = ntohs(sa.sin_port);
+      }
+    }
+    if (rc != 0) {
+      int e = errno;
+      close(fd);
+      return -e;
+    }
+    br->socks.push_back(fd);
+    br->readers.emplace_back(reader_loop, br, fd);
+  }
+  br->bound_port = bound;
+  return bound;
+}
+
+void vtpu_stop(void* h) {
+  Bridge* br = static_cast<Bridge*>(h);
+  br->stop.store(true);
+  for (auto& t : br->readers)
+    if (t.joinable()) t.join();
+  br->readers.clear();
+  for (int s : br->socks) close(s);
+  br->socks.clear();
+}
+
+// Drain up to max_n staged samples for `bank` into caller arrays.
+// histo/counter: a=values  b=weights;  gauge: a=values  c=seqs;
+// set: a=rho  c=register index.
+int32_t vtpu_poll(void* h, int32_t bank, int32_t max_n, int32_t* slots,
+                  float* a, float* b, int32_t* c) {
+  Bridge* br = static_cast<Bridge*>(h);
+  return static_cast<int32_t>(
+      br->rings[bank].pop(slots, a, b, c, static_cast<size_t>(max_n)));
+}
+
+// Drain newly-interned keys as packed records:
+//   bank u8 | mtype u8 | scope u8 | slot i32le | name_len u16le | name |
+//   tags_len u16le | tags
+// Returns bytes written; 0 when empty. Records are never split.
+int32_t vtpu_drain_new_keys(void* h, uint8_t* buf, int32_t buf_len) {
+  Bridge* br = static_cast<Bridge*>(h);
+  std::lock_guard<std::mutex> g(br->newkeys_mu);
+  int32_t off = 0;
+  while (!br->newkeys.empty()) {
+    const NewKey& nk = br->newkeys.front();
+    int32_t need = 3 + 4 + 2 + static_cast<int32_t>(nk.name.size()) + 2 +
+                   static_cast<int32_t>(nk.tags.size());
+    if (off + need > buf_len) break;
+    buf[off++] = nk.bank;
+    buf[off++] = nk.mtype;
+    buf[off++] = nk.scope;
+    memcpy(buf + off, &nk.slot, 4);
+    off += 4;
+    uint16_t nl = static_cast<uint16_t>(nk.name.size());
+    memcpy(buf + off, &nl, 2);
+    off += 2;
+    memcpy(buf + off, nk.name.data(), nl);
+    off += nl;
+    uint16_t tl = static_cast<uint16_t>(nk.tags.size());
+    memcpy(buf + off, &tl, 2);
+    off += 2;
+    memcpy(buf + off, nk.tags.data(), tl);
+    off += tl;
+    br->newkeys.pop_front();
+  }
+  return off;
+}
+
+// Drain slow-path lines (events, service checks, py-float oddities) as
+// u16le length-prefixed raw byte strings. Returns bytes written.
+int32_t vtpu_drain_other(void* h, uint8_t* buf, int32_t buf_len) {
+  Bridge* br = static_cast<Bridge*>(h);
+  std::lock_guard<std::mutex> g(br->other_mu);
+  int32_t off = 0;
+  while (!br->other.empty()) {
+    const std::string& s = br->other.front();
+    int32_t need = 2 + static_cast<int32_t>(s.size());
+    if (off + need > buf_len) break;
+    uint16_t sl = static_cast<uint16_t>(s.size());
+    memcpy(buf + off, &sl, 2);
+    off += 2;
+    memcpy(buf + off, s.data(), sl);
+    off += sl;
+    br->other.pop_front();
+  }
+  return off;
+}
+
+// Bulk-read per-slot scopes for `bank` (flush-time snapshot).
+void vtpu_slot_scopes(void* h, int32_t bank, uint8_t* out, int32_t n) {
+  Bridge* br = static_cast<Bridge*>(h);
+  BankMeta& bm = br->banks[bank];
+  int32_t lim = std::min(n, bm.capacity);
+  for (int32_t i = 0; i < lim; i++)
+    out[i] = bm.scope[i].load(std::memory_order_relaxed);
+}
+
+// Advance `bank`'s interval counter and evict keys idle > idle_ttl
+// intervals (KeyInterner.advance_interval's eviction). Returns number
+// evicted. Gauge advance also resets the per-interval gauge sequence.
+int32_t vtpu_advance_interval(void* h, int32_t bank) {
+  Bridge* br = static_cast<Bridge*>(h);
+  BankMeta& bm = br->banks[bank];
+  uint32_t now = bm.interval.fetch_add(1, std::memory_order_relaxed) + 1;
+  if (br->idle_ttl <= 0 || now < static_cast<uint32_t>(br->idle_ttl))
+    return 0;
+  uint32_t horizon = now - static_cast<uint32_t>(br->idle_ttl);
+  int32_t evicted = 0;
+  for (int s = 0; s < NUM_SHARDS; s++) {
+    Shard& sh = br->shards[s];
+    std::lock_guard<std::mutex> g(sh.mu);
+    for (auto it = sh.map[bank].begin(); it != sh.map[bank].end();) {
+      int32_t slot = it->second;
+      if (bm.last_interval[slot].load(std::memory_order_relaxed) < horizon) {
+        {
+          std::lock_guard<std::mutex> fg(bm.free_mu);
+          bm.free_slots.push_back(slot);
+        }
+        bm.key_count.fetch_add(-1, std::memory_order_relaxed);
+        it = sh.map[bank].erase(it);
+        evicted++;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return evicted;
+}
+
+// Intern one key from the Python side (the slow path / ssfmetrics bridge /
+// global-tier Combine all reach interning through here in native mode).
+// Returns the slot, or -1 when the bank is full.
+int32_t vtpu_intern(void* h, int32_t mtype, int32_t scope,
+                    const uint8_t* name, int32_t name_len,
+                    const uint8_t* tags, int32_t tags_len) {
+  Bridge* br = static_cast<Bridge*>(h);
+  thread_local ParsedMetric m;
+  thread_local std::string keybuf;
+  m.mtype = static_cast<MType>(mtype);
+  m.scope = static_cast<uint8_t>(scope);
+  m.name.assign(reinterpret_cast<const char*>(name),
+                static_cast<size_t>(name_len));
+  m.joined_tags.assign(reinterpret_cast<const char*>(tags),
+                       static_cast<size_t>(tags_len));
+  uint32_t hh = fnv1a_32(name, static_cast<size_t>(name_len), FNV32_OFFSET);
+  const char* tn = MTYPE_NAMES[mtype];
+  hh = fnv1a_32(reinterpret_cast<const uint8_t*>(tn), strlen(tn), hh);
+  hh = fnv1a_32(tags, static_cast<size_t>(tags_len), hh);
+  m.digest = hh;
+  return intern_key(br, m, &keybuf);
+}
+
+int64_t vtpu_key_count(void* h, int32_t bank) {
+  return static_cast<Bridge*>(h)->banks[bank].key_count.load();
+}
+
+// stats[0..8] = packets, lines, samples, parse_errors, slow_routed,
+//               drops_no_slot(sum), ring_drops(sum), other_drops,
+//               pending_other
+void vtpu_stats(void* h, uint64_t* out) {
+  Bridge* br = static_cast<Bridge*>(h);
+  out[0] = br->packets.load();
+  out[1] = br->lines.load();
+  out[2] = br->samples.load();
+  out[3] = br->parse_errors.load();
+  out[4] = br->slow_routed.load();
+  uint64_t no_slot = 0, ring_drops = 0;
+  for (int i = 0; i < NUM_BANKS; i++) {
+    no_slot += br->banks[i].drops_no_slot.load();
+    std::lock_guard<std::mutex> g(br->rings[i].mu);
+    ring_drops += br->rings[i].drops;
+  }
+  out[5] = no_slot;
+  out[6] = ring_drops;
+  std::lock_guard<std::mutex> g(br->other_mu);
+  out[7] = br->other_drops;
+  out[8] = br->other.size();
+}
+
+// -------- conformance/testing helpers (stateless parse of one line) -----
+// Returns the ParseVerdict. On P_METRIC fills the packed record:
+//   mtype u8 | scope u8 | rate f64le | value f64le | digest u32le |
+//   name_len u16le | name | tags_len u16le | tags |
+//   member_len u16le | member
+int32_t vtpu_parse_one(const uint8_t* data, int32_t len, uint8_t* buf,
+                       int32_t buf_len, int32_t* out_len) {
+  std::vector<std::pair<const uint8_t*, size_t>> secs, tags;
+  ParsedMetric m;
+  ParseVerdict v = parse_line(data, static_cast<size_t>(len), &m, &secs,
+                              &tags);
+  *out_len = 0;
+  if (v != P_METRIC) return v;
+  int32_t need = 1 + 1 + 8 + 8 + 4 + 2 +
+                 static_cast<int32_t>(m.name.size()) + 2 +
+                 static_cast<int32_t>(m.joined_tags.size()) + 2 +
+                 static_cast<int32_t>(m.member.size());
+  if (need > buf_len) return P_ERROR;
+  int32_t off = 0;
+  buf[off++] = m.mtype;
+  buf[off++] = m.scope;
+  memcpy(buf + off, &m.rate, 8);
+  off += 8;
+  memcpy(buf + off, &m.value, 8);
+  off += 8;
+  memcpy(buf + off, &m.digest, 4);
+  off += 4;
+  uint16_t nl = static_cast<uint16_t>(m.name.size());
+  memcpy(buf + off, &nl, 2);
+  off += 2;
+  memcpy(buf + off, m.name.data(), nl);
+  off += nl;
+  uint16_t tl = static_cast<uint16_t>(m.joined_tags.size());
+  memcpy(buf + off, &tl, 2);
+  off += 2;
+  memcpy(buf + off, m.joined_tags.data(), tl);
+  off += tl;
+  uint16_t ml = static_cast<uint16_t>(m.member.size());
+  memcpy(buf + off, &ml, 2);
+  off += 2;
+  memcpy(buf + off, m.member.data(), ml);
+  off += ml;
+  *out_len = off;
+  return P_METRIC;
+}
+
+// Parse-only throughput probe: parse the given newline-separated buffer
+// `iters` times with no interning/staging; returns seconds elapsed.
+double vtpu_bench_parse(const uint8_t* data, int32_t len, int32_t iters) {
+  std::vector<std::pair<const uint8_t*, size_t>> secs, tags;
+  ParsedMetric m;
+  timespec t0, t1;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  for (int32_t it = 0; it < iters; it++) {
+    size_t i = 0;
+    size_t n = static_cast<size_t>(len);
+    while (i < n) {
+      const uint8_t* nl =
+          static_cast<const uint8_t*>(memchr(data + i, '\n', n - i));
+      size_t ll = nl ? static_cast<size_t>(nl - (data + i)) : n - i;
+      if (ll > 0) parse_line(data + i, ll, &m, &secs, &tags);
+      i += ll + 1;
+    }
+  }
+  clock_gettime(CLOCK_MONOTONIC, &t1);
+  return (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) * 1e-9;
+}
+
+int32_t vtpu_bound_port(void* h) {
+  return static_cast<Bridge*>(h)->bound_port;
+}
+
+}  // extern "C"

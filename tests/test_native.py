@@ -1,0 +1,281 @@
+"""C++ ingest bridge tests.
+
+Three layers, mirroring the reference's parser/worker/server test split
+(samplers/parser_test.go, worker_test.go, server_test.go):
+  1. parse conformance — the C++ parser must agree with the Python
+     reference parser line-for-line on the shared corpus plus randomized
+     lines (verdict, name, type, tags, digest, value, rate, scope).
+  2. bridge mechanics — interning, ring draining, new-key records, slow
+     path routing, eviction.
+  3. end-to-end — a native-mode Server ingesting over loopback UDP must
+     produce the same flush output as the Python path.
+"""
+
+import random
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.ingest import parser
+from veneur_tpu.utils import hashing
+
+native = pytest.importorskip("veneur_tpu.ingest.native")
+
+try:
+    native.load()
+except native.NativeUnavailable as e:  # pragma: no cover
+    pytest.skip(f"native build unavailable: {e}", allow_module_level=True)
+
+from tests.test_parser import INVALID, VALID  # shared corpus
+
+
+def py_verdict(line: bytes):
+    """What the Python reference does with a line."""
+    if line.startswith(b"_e{") or line.startswith(b"_sc|"):
+        return "other", None
+    try:
+        m = parser.parse_metric(line)
+    except parser.ParseError:
+        return "error", None
+    return "metric", m
+
+
+def assert_conformant(line: bytes):
+    pv, pm = py_verdict(line)
+    cv, cm = native.parse_one(line)
+    if cv == native.P_OTHER:
+        # C++ may punt to Python on lines it can't prove bit-identical;
+        # that's conformant by construction (Python handles them), but
+        # events/service-checks must always punt.
+        return
+    if pv == "metric":
+        assert cv == native.P_METRIC, f"C++ rejected valid line {line!r}"
+        assert cm["name"] == pm.key.name
+        assert cm["type"] == pm.key.type
+        assert cm["joined_tags"] == pm.key.joined_tags
+        assert cm["digest"] == pm.digest
+        assert cm["sample_rate"] == pm.sample_rate
+        assert cm["scope"] == pm.scope
+        if pm.key.type == "set":
+            assert cm["value"] == pm.value
+        else:
+            assert cm["value"] == pytest.approx(pm.value, rel=0, abs=0)
+    else:
+        assert cv == native.P_ERROR, \
+            f"C++ accepted invalid line {line!r}: {cm}"
+
+
+class TestParseConformance:
+    @pytest.mark.parametrize("case", VALID, ids=[v[0].decode()
+                                                 for v in VALID])
+    def test_valid_corpus(self, case):
+        assert_conformant(case[0])
+
+    @pytest.mark.parametrize("line", INVALID,
+                             ids=[repr(l) for l in INVALID])
+    def test_invalid_corpus(self, line):
+        assert_conformant(line)
+
+    def test_events_and_checks_punt(self):
+        assert native.parse_one(b"_e{2,3}:ab|cde")[0] == native.P_OTHER
+        assert native.parse_one(b"_sc|svc|0")[0] == native.P_OTHER
+
+    def test_invalid_utf8_punts(self):
+        assert native.parse_one(b"nam\xff:1|c")[0] == native.P_OTHER
+
+    def test_underscore_value_punts(self):
+        # CPython float("1_0") == 10.0; C++ must not guess
+        assert native.parse_one(b"a:1_0|c")[0] == native.P_OTHER
+
+    def test_randomized(self):
+        rng = random.Random(42)
+        names = ["a", "api.req", "x.y.z", "srv-1.count", "m" * 40]
+        types = ["c", "g", "ms", "h", "s", "d", "q", ""]
+        tagsets = ["", "#a:b", "#b,a", "#veneurlocalonly",
+                   "#veneurglobalonly,t:1", "#dup,dup"]
+        rates = ["", "@0.5", "@1", "@2", "@0", "@x"]
+        values = ["1", "-2.5", "1e3", "abc", "", "inf", "nan", "1.5e-2"]
+        for _ in range(3000):
+            line = (f"{rng.choice(names)}:{rng.choice(values)}"
+                    f"|{rng.choice(types)}")
+            for extra in (rng.choice(rates), rng.choice(tagsets)):
+                if extra:
+                    line += "|" + extra
+            assert_conformant(line.encode())
+
+    def test_bench_hook(self):
+        lines = b"\n".join(
+            f"api.req.time_{i % 97}:{i % 113}|ms|#svc:web,env:prod"
+            .encode() for i in range(1000))
+        arr = np.frombuffer(bytearray(lines), np.uint8)
+        lib = native.load()
+        dt = lib.vtpu_bench_parse(native._u8(arr), len(lines), 10)
+        assert dt > 0
+
+
+@pytest.fixture
+def bridge():
+    br = native.NativeBridge(histo_slots=64, counter_slots=64,
+                             gauge_slots=64, set_slots=64,
+                             hll_precision=14, idle_ttl=4,
+                             ring_capacity=4096, max_packet=8192)
+    yield br
+    br.close()
+
+
+def poll_all(br, bank, n=4096):
+    slots = np.zeros(n, np.int32)
+    a = np.zeros(n, np.float32)
+    b = np.zeros(n, np.float32)
+    c = np.zeros(n, np.int32)
+    got = br.poll(bank, slots, a, b, c)
+    return got, slots[:got], a[:got], b[:got], c[:got]
+
+
+class TestBridge:
+    def test_counter_roundtrip(self, bridge):
+        bridge.handle_packet(b"hits:3|c|@0.5\nhits:1|c\nother:2|c")
+        got, slots, vals, wts, _ = poll_all(bridge, "counter")
+        assert got == 3
+        keys = bridge.drain_new_keys()
+        assert len(keys) == 2
+        by_name = {k[4]: k for k in keys}
+        assert set(by_name) == {"hits", "other"}
+        hit_slot = by_name["hits"][3]
+        mask = slots == hit_slot
+        assert mask.sum() == 2
+        # 1/rate weights
+        assert sorted(wts[mask].tolist()) == [1.0, 2.0]
+        assert sorted(vals[mask].tolist()) == [1.0, 3.0]
+
+    def test_histo_timer_distinct_keys(self, bridge):
+        # same name, different type -> distinct keys (digest covers type)
+        bridge.handle_packet(b"x:1|ms\nx:1|h")
+        keys = bridge.drain_new_keys()
+        assert len(keys) == 2
+        assert {k[1] for k in keys} == {2, 3}  # MT_TIMER, MT_HISTOGRAM
+
+    def test_set_rho_matches_python(self, bridge):
+        bridge.handle_packet(b"users:alice|s\nusers:bob|s")
+        got, slots, rho, _, idx = poll_all(bridge, "set")
+        assert got == 2
+        p = 14
+        expect = []
+        for member in ("alice", "bob"):
+            h = hashing.set_member_hash(member)
+            eidx = h >> (64 - p)
+            rest = ((h << p) & 0xFFFFFFFFFFFFFFFF) | ((1 << p) - 1)
+            expect.append((eidx, 65 - rest.bit_length()))
+        got_pairs = sorted(zip(idx.tolist(), rho.astype(int).tolist()))
+        assert got_pairs == sorted(expect)
+
+    def test_scope_tags(self, bridge):
+        bridge.handle_packet(b"t:1|ms|#veneurglobalonly")
+        keys = bridge.drain_new_keys()
+        assert keys[0][2] == parser.GLOBAL_ONLY
+        scopes = bridge.slot_scopes("histo")
+        assert scopes[keys[0][3]] == parser.GLOBAL_ONLY
+
+    def test_slow_path_routing(self, bridge):
+        bridge.handle_packet(b"_e{2,2}:ab|cd\n_sc|s|0\na:1_0|c")
+        other = bridge.drain_other()
+        assert other == [b"_e{2,2}:ab|cd", b"_sc|s|0", b"a:1_0|c"]
+
+    def test_parse_errors_counted(self, bridge):
+        bridge.handle_packet(b"bad\n:1|c\na:1|q")
+        assert bridge.stats()["parse_errors"] == 3
+
+    def test_bank_full_drops(self, bridge):
+        for i in range(200):
+            bridge.handle_packet(f"m{i}:1|c".encode())
+        st = bridge.stats()
+        assert st["drops_no_slot"] == 200 - 64
+        assert bridge.key_count("counter") == 64
+
+    def test_eviction(self, bridge):
+        bridge.handle_packet(b"old:1|c")
+        for _ in range(6):
+            bridge.advance_interval("counter")
+            bridge.handle_packet(b"fresh:1|c")
+        assert bridge.key_count("counter") == 1  # "old" evicted
+
+    def test_intern_matches_parse_path(self, bridge):
+        bridge.handle_packet(b"hits:1|c|#a:b")
+        (_, _, _, slot, _, _), = bridge.drain_new_keys()
+        # interning the same key from Python returns the same slot
+        assert bridge.intern("counter", 0, "hits", "a:b") == slot
+        assert bridge.intern("counter", 0, "hits", "a:c") != slot
+
+    def test_udp_readers(self, bridge):
+        port = bridge.start_udp("127.0.0.1", 0, 2)
+        assert port > 0
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for i in range(50):
+            s.sendto(f"udp.m:{i}|ms".encode(), ("127.0.0.1", port))
+        s.close()
+        deadline = time.monotonic() + 5
+        total = 0
+        while total < 50 and time.monotonic() < deadline:
+            got, *_ = poll_all(bridge, "histo")
+            total += got
+            time.sleep(0.01)
+        assert total == 50
+        bridge.stop()
+
+
+class TestNativeServer:
+    def test_end_to_end_matches_python_path(self):
+        """Same traffic through a native-mode and a Python-mode server
+        must produce identical flush output."""
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks.basic import CaptureMetricSink
+
+        lines = [b"api.t:5|ms|#svc:a", b"api.t:15|ms|#svc:a",
+                 b"hits:2|c|@0.5", b"temp:70|g", b"temp:71|g",
+                 b"users:alice|s", b"users:bob|s", b"users:alice|s",
+                 b"_sc|db|0", b"_e{2,2}:ab|cd"]
+
+        def run(native_on: bool):
+            cap = CaptureMetricSink()
+            cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                         interval="10s", hostname="h",
+                         native_ingest=native_on,
+                         percentiles=[0.5], aggregates=["max", "count"])
+            srv = Server(cfg, sinks=[cap], span_sinks=[])
+            srv.start()
+            try:
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                port = srv.bound_port()
+                for ln in lines:
+                    sock.sendto(ln, ("127.0.0.1", port))
+                sock.close()
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    if native_on:
+                        done = int(srv.native_bridge.stats()["lines"]) \
+                            >= len(lines)
+                    else:
+                        done = srv.packets_received >= len(lines)
+                    if done:
+                        break
+                    time.sleep(0.01)
+                assert srv.drain()
+                srv.flush_once(timestamp=1000)
+                cap.wait_for_flush()
+                out = {(m.name, tuple(m.tags)): m.value
+                       for fl in cap.flushes for m in fl
+                       if not m.name.startswith("veneur.")}
+                ev = cap.events
+                return out, ev
+            finally:
+                srv.stop()
+
+        native_out, native_ev = run(True)
+        py_out, py_ev = run(False)
+        assert set(native_out) == set(py_out)
+        for k in py_out:
+            assert native_out[k] == pytest.approx(py_out[k]), k
+        assert len(native_ev) == len(py_ev)

@@ -118,6 +118,13 @@ class Config:
     tpu_slot_idle_ttl_intervals: int = 16
     tpu_num_devices: int = 0           # 0 = all visible devices
 
+    # --- native C++ ingest bridge (native/vtpu_ingest.cpp) ---
+    # When on, UDP DogStatsD ingest (readers + parse + key interning +
+    # batch assembly) runs in the C++ bridge and Python only pumps
+    # device-ready batches; one engine owns the full slot space.
+    native_ingest: bool = False
+    native_ring_capacity: int = 1 << 20
+
     # populated by the loader, not a YAML key:
     is_global: bool = False
 

@@ -1,0 +1,484 @@
+"""ctypes bindings + pump for the C++ ingest bridge (native/vtpu_ingest.cpp).
+
+The bridge is the TPU build's native analogue of veneur's ingest front half
+(server.go sym: Server.ReadMetricSocket ×num_readers on SO_REUSEPORT
+sockets; samplers/parser.go sym: ParseMetric; the digest-sharded dispatch of
+worker.go): C++ reader threads parse DogStatsD lines, intern MetricKeys to
+device-bank slots, and stage (slot, value, weight) samples in per-bank
+rings. Python's job shrinks to polling device-ready batches.
+
+Pieces here:
+  * build()/load(): compile (once) and dlopen the shared library.
+  * NativeBridge: the raw C API, numpy-typed.
+  * BridgeKeyView: presents a bridge bank through the KeyInterner interface
+    (active_items / scope_of / key_of / advance_interval / dropped_no_slot)
+    so AggregationEngine.flush works unchanged on top of C++ interning.
+  * NativePump: the polling thread — drains sample rings into the engine's
+    batch-ingest kernels, keeps the slot→key mirrors fresh, and routes
+    slow-path lines (events, service checks, CPython-float oddities,
+    invalid UTF-8) through the Python parser.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+import numpy as np
+
+from ..ingest.parser import MetricKey
+
+_BANKS = {"histo": 0, "counter": 1, "gauge": 2, "set": 3}
+_MTYPE_NAMES = ["counter", "gauge", "timer", "histogram", "set"]
+
+P_METRIC, P_ERROR, P_OTHER = 0, 1, 2
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libvtpu_ingest.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def build(force: bool = False) -> str:
+    """Compile the shared library if missing. Returns its path."""
+    src = os.path.join(_NATIVE_DIR, "vtpu_ingest.cpp")
+    if not os.path.exists(src):
+        raise NativeUnavailable(f"source missing: {src}")
+    if force or not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
+        proc = subprocess.run(["make", "-C", _NATIVE_DIR],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeUnavailable(
+                f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = build()
+        lib = ctypes.CDLL(path)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.vtpu_create.restype = ctypes.c_void_p
+        lib.vtpu_create.argtypes = [ctypes.c_int32] * 8
+        lib.vtpu_destroy.argtypes = [ctypes.c_void_p]
+        lib.vtpu_handle_packet.argtypes = [ctypes.c_void_p, u8p,
+                                           ctypes.c_int32]
+        lib.vtpu_start_udp.restype = ctypes.c_int32
+        lib.vtpu_start_udp.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int32, ctypes.c_int32,
+                                       ctypes.c_int32]
+        lib.vtpu_stop.argtypes = [ctypes.c_void_p]
+        lib.vtpu_poll.restype = ctypes.c_int32
+        lib.vtpu_poll.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                  ctypes.c_int32, i32p, f32p, f32p, i32p]
+        lib.vtpu_drain_new_keys.restype = ctypes.c_int32
+        lib.vtpu_drain_new_keys.argtypes = [ctypes.c_void_p, u8p,
+                                            ctypes.c_int32]
+        lib.vtpu_drain_other.restype = ctypes.c_int32
+        lib.vtpu_drain_other.argtypes = [ctypes.c_void_p, u8p,
+                                         ctypes.c_int32]
+        lib.vtpu_slot_scopes.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                         u8p, ctypes.c_int32]
+        lib.vtpu_advance_interval.restype = ctypes.c_int32
+        lib.vtpu_advance_interval.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int32]
+        lib.vtpu_key_count.restype = ctypes.c_int64
+        lib.vtpu_key_count.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.vtpu_intern.restype = ctypes.c_int32
+        lib.vtpu_intern.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                    ctypes.c_int32, u8p, ctypes.c_int32,
+                                    u8p, ctypes.c_int32]
+        lib.vtpu_stats.argtypes = [ctypes.c_void_p, u64p]
+        lib.vtpu_parse_one.restype = ctypes.c_int32
+        lib.vtpu_parse_one.argtypes = [u8p, ctypes.c_int32, u8p,
+                                       ctypes.c_int32, i32p]
+        lib.vtpu_bench_parse.restype = ctypes.c_double
+        lib.vtpu_bench_parse.argtypes = [u8p, ctypes.c_int32,
+                                         ctypes.c_int32]
+        lib.vtpu_bound_port.restype = ctypes.c_int32
+        lib.vtpu_bound_port.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _f32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def parse_one(line: bytes):
+    """Stateless conformance parse via the C++ parser.
+
+    Returns (verdict, fields|None) where fields mirror
+    parser.parse_metric's result: dict(name, type, joined_tags, digest,
+    value, sample_rate, scope)."""
+    lib = load()
+    buf = np.zeros(4 + len(line) * 2 + 256, np.uint8)
+    out_len = ctypes.c_int32(0)
+    arr = np.frombuffer(bytearray(line), np.uint8) if line else \
+        np.zeros(1, np.uint8)
+    v = lib.vtpu_parse_one(_u8(arr), len(line), _u8(buf), len(buf),
+                           ctypes.byref(out_len))
+    if v != P_METRIC:
+        return v, None
+    b = buf.tobytes()[:out_len.value]
+    mtype, scope = b[0], b[1]
+    rate, value = struct.unpack_from("<dd", b, 2)
+    (digest,) = struct.unpack_from("<I", b, 18)
+    off = 22
+    (nl,) = struct.unpack_from("<H", b, off)
+    off += 2
+    name = b[off:off + nl].decode()
+    off += nl
+    (tl,) = struct.unpack_from("<H", b, off)
+    off += 2
+    tags = b[off:off + tl].decode()
+    off += tl
+    (ml,) = struct.unpack_from("<H", b, off)
+    off += 2
+    member = b[off:off + ml].decode()
+    return v, {
+        "name": name, "type": _MTYPE_NAMES[mtype], "joined_tags": tags,
+        "digest": digest, "value": member if _MTYPE_NAMES[mtype] == "set"
+        else value, "sample_rate": rate, "scope": scope,
+    }
+
+
+class NativeBridge:
+    """Owning wrapper over one C++ bridge instance."""
+
+    def __init__(self, histo_slots: int, counter_slots: int,
+                 gauge_slots: int, set_slots: int, hll_precision: int = 14,
+                 idle_ttl: int = 16, ring_capacity: int = 1 << 20,
+                 max_packet: int = 8192):
+        self._lib = load()
+        self._h = self._lib.vtpu_create(
+            histo_slots, counter_slots, gauge_slots, set_slots,
+            hll_precision, idle_ttl, ring_capacity, max_packet)
+        self.capacities = {"histo": histo_slots, "counter": counter_slots,
+                           "gauge": gauge_slots, "set": set_slots}
+        self._key_buf = np.zeros(1 << 20, np.uint8)
+        self._other_buf = np.zeros(1 << 20, np.uint8)
+        self._closed = False
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._lib.vtpu_destroy(self._h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -------- ingest --------
+
+    def handle_packet(self, data: bytes):
+        arr = np.frombuffer(bytearray(data), np.uint8) if data else \
+            np.zeros(1, np.uint8)
+        self._lib.vtpu_handle_packet(self._h, _u8(arr), len(data))
+
+    def start_udp(self, host: str, port: int, n_readers: int,
+                  rcvbuf: int = 0) -> int:
+        rc = self._lib.vtpu_start_udp(
+            self._h, host.encode(), port, n_readers, rcvbuf)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return rc
+
+    def stop(self):
+        self._lib.vtpu_stop(self._h)
+
+    def bound_port(self) -> int:
+        return self._lib.vtpu_bound_port(self._h)
+
+    # -------- draining --------
+
+    def poll(self, bank: str, out_slots, out_a, out_b, out_c) -> int:
+        return self._lib.vtpu_poll(
+            self._h, _BANKS[bank], len(out_slots), _i32(out_slots),
+            _f32(out_a), _f32(out_b), _i32(out_c))
+
+    def drain_new_keys(self):
+        """Yield (bank, mtype, scope, slot, name, joined_tags)."""
+        out = []
+        while True:
+            n = self._lib.vtpu_drain_new_keys(
+                self._h, _u8(self._key_buf), len(self._key_buf))
+            if n <= 0:
+                break
+            b = self._key_buf.tobytes()[:n]
+            off = 0
+            while off < n:
+                bank, mtype, scope = b[off], b[off + 1], b[off + 2]
+                (slot,) = struct.unpack_from("<i", b, off + 3)
+                off += 7
+                (nl,) = struct.unpack_from("<H", b, off)
+                off += 2
+                name = b[off:off + nl].decode()
+                off += nl
+                (tl,) = struct.unpack_from("<H", b, off)
+                off += 2
+                tags = b[off:off + tl].decode()
+                off += tl
+                out.append((bank, mtype, scope, slot, name, tags))
+            if n < len(self._key_buf) // 2:
+                break
+        return out
+
+    def drain_other(self):
+        """Yield raw slow-path lines (bytes)."""
+        out = []
+        while True:
+            n = self._lib.vtpu_drain_other(
+                self._h, _u8(self._other_buf), len(self._other_buf))
+            if n <= 0:
+                break
+            b = self._other_buf.tobytes()[:n]
+            off = 0
+            while off < n:
+                (sl,) = struct.unpack_from("<H", b, off)
+                off += 2
+                out.append(b[off:off + sl])
+                off += sl
+            if n < len(self._other_buf) // 2:
+                break
+        return out
+
+    def slot_scopes(self, bank: str) -> np.ndarray:
+        out = np.zeros(self.capacities[bank], np.uint8)
+        self._lib.vtpu_slot_scopes(self._h, _BANKS[bank], _u8(out),
+                                   len(out))
+        return out
+
+    def advance_interval(self, bank: str) -> int:
+        return self._lib.vtpu_advance_interval(self._h, _BANKS[bank])
+
+    def key_count(self, bank: str) -> int:
+        return self._lib.vtpu_key_count(self._h, _BANKS[bank])
+
+    def intern(self, mtype: str, scope: int, name: str,
+               joined_tags: str) -> int:
+        """Intern one key through the C++ table (slow path, ssfmetrics
+        bridge, global-tier Combine). Returns slot or -1."""
+        nb = name.encode()
+        tb = joined_tags.encode()
+        na = np.frombuffer(bytearray(nb), np.uint8) if nb else \
+            np.zeros(1, np.uint8)
+        ta = np.frombuffer(bytearray(tb), np.uint8) if tb else \
+            np.zeros(1, np.uint8)
+        return self._lib.vtpu_intern(
+            self._h, _MTYPE_NAMES.index(mtype), scope, _u8(na), len(nb),
+            _u8(ta), len(tb))
+
+    def stats(self) -> dict:
+        out = np.zeros(9, np.uint64)
+        self._lib.vtpu_stats(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        keys = ("packets", "lines", "samples", "parse_errors",
+                "slow_routed", "drops_no_slot", "ring_drops",
+                "other_drops", "pending_other")
+        return dict(zip(keys, out.tolist()))
+
+
+class BridgeKeyView:
+    """KeyInterner-shaped facade over one bridge bank.
+
+    AggregationEngine.flush consumes active_items()/scope_of()/key_of()/
+    advance_interval()/len()/dropped_no_slot; here those are backed by the
+    C++ interner plus a host mirror:
+      * slot→MetricKey mirror, updated from drain_new_keys()
+      * touched mask, updated by the pump from each polled batch (exact
+        w.r.t. bank contents — no interval race with the readers)
+      * scope snapshot, refreshed at flush time.
+    """
+
+    def __init__(self, bridge: NativeBridge, bank: str):
+        self.bridge = bridge
+        self.bank = bank
+        self.capacity = bridge.capacities[bank]
+        self.mirror: dict[int, MetricKey] = {}
+        self.touched = np.zeros(self.capacity, bool)
+        self._scopes = np.zeros(self.capacity, np.uint8)
+        self.dropped_no_slot = 0
+
+    def __len__(self):
+        return self.bridge.key_count(self.bank)
+
+    def lookup(self, key: MetricKey, scope: int) -> int:
+        """KeyInterner.lookup parity for the engine's Python entry points
+        (engine.process on slow-path lines, import_* Combine staging):
+        interns through the C++ table, mirrors, and marks touched.
+        Caller holds the engine lock, so mark+dispatch is atomic w.r.t.
+        flush."""
+        slot = self.bridge.intern(key.type, scope, key.name,
+                                  key.joined_tags)
+        if slot < 0:
+            self.dropped_no_slot += 1
+            return -1
+        self.mirror[slot] = key
+        self.touched[slot] = True
+        return slot
+
+    def register(self, slot: int, key: MetricKey):
+        self.mirror[slot] = key
+
+    def mark(self, slots: np.ndarray):
+        self.touched[slots] = True
+
+    def refresh_scopes(self):
+        self._scopes = self.bridge.slot_scopes(self.bank)
+
+    def key_of(self, slot: int):
+        return self.mirror.get(slot)
+
+    def scope_of(self, slot: int) -> int:
+        return int(self._scopes[slot])
+
+    def active_items(self):
+        self.refresh_scopes()
+        out = []
+        for slot in np.nonzero(self.touched)[0].tolist():
+            key = self.mirror.get(slot)
+            if key is not None:
+                out.append((key, slot))
+        return out
+
+    def advance_interval(self):
+        self.touched[:] = False
+        self.bridge.advance_interval(self.bank)
+
+
+class NativePump:
+    """Polls the bridge and feeds the engine's batch-ingest kernels.
+
+    One pump thread replaces the per-packet Python parse path: it moves
+    staged samples bank-by-bank into the XLA scatter programs in
+    `batch`-sized chunks (fixed shapes — no recompiles), mirrors new key
+    registrations, and hands slow-path lines to `slow_path` (the Python
+    parser + engine.process round trip).
+    """
+
+    def __init__(self, bridge: NativeBridge, engine, views: dict,
+                 slow_path, batch: int = 8192, idle_sleep: float = 0.002):
+        self.bridge = bridge
+        self.engine = engine
+        self.views = views
+        self.slow_path = slow_path
+        self.batch = batch
+        self.idle_sleep = idle_sleep
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # pump_once may be called by both the pump thread and
+        # Server.drain(); they share the poll buffers, so cycles are
+        # serialized
+        self._pump_lock = threading.Lock()
+        self._bufs = {
+            b: (np.zeros(batch, np.int32), np.zeros(batch, np.float32),
+                np.zeros(batch, np.float32), np.zeros(batch, np.int32))
+            for b in _BANKS
+        }
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="native-pump",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        import time
+        while not self._stop.is_set():
+            moved = self.pump_once()
+            if moved == 0:
+                time.sleep(self.idle_sleep)
+
+    def pump_once(self) -> int:
+        """One poll cycle across all banks; returns items moved."""
+        with self._pump_lock:
+            moved = 0
+            for bank in _BANKS:
+                moved += self._pump_bank(bank)
+            for line in self.bridge.drain_other():
+                self.slow_path(line)
+                moved += 1
+            return moved
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Pump until the bridge is empty (deterministic test settling:
+        the analogue of Server.drain's queue accounting)."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            moved = self.pump_once()
+            if moved == 0 and self.bridge.stats()["pending_other"] == 0:
+                return True
+        return False
+
+    def _sync_keys(self):
+        for bank_i, mtype, scope, slot, name, tags in \
+                self.bridge.drain_new_keys():
+            bank = ("histo", "counter", "gauge", "set")[bank_i]
+            key = MetricKey(name=name, type=_MTYPE_NAMES[mtype],
+                            joined_tags=tags)
+            self.views[bank].register(slot, key)
+            del scope
+
+    def _pump_bank(self, bank: str) -> int:
+        slots, a, b, c = self._bufs[bank]
+        total = 0
+        while True:
+            n = self.bridge.poll(bank, slots, a, b, c)
+            if n <= 0:
+                break
+            if n < self.batch:
+                slots[n:] = -1  # pad rows are dropped by the kernels
+            # Sync key records BEFORE marking/dispatching this batch: the
+            # bridge enqueues a new-key record before the first sample for
+            # that key reaches a ring, so every slot in this batch has its
+            # mirror entry drainable now — a flush interleaving after
+            # dispatch can always resolve slot→key.
+            self._sync_keys()
+            view = self.views[bank]
+            mark = lambda sl: view.mark(sl)  # runs under the engine lock
+            eng = self.engine
+            if bank == "histo":
+                eng.ingest_histo_batch(slots, a, b, count=n, mark=mark)
+            elif bank == "counter":
+                eng.ingest_counter_batch(slots, a, b, count=n, mark=mark)
+            elif bank == "gauge":
+                eng.ingest_gauge_batch(slots, a, count=n, mark=mark)
+            else:
+                eng.ingest_set_batch(slots, c, a.astype(np.uint8),
+                                     count=n, mark=mark)
+            total += n
+            if n < self.batch:
+                break
+        return total

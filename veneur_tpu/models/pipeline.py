@@ -217,6 +217,54 @@ class AggregationEngine:
             if st.full():
                 self._dispatch_sets()
 
+    # ---- pre-interned batch ingest (the native C++ bridge's path) ----
+    # Slots were assigned by the bridge's interner; rows with slot -1 are
+    # padding the kernels drop. `mark` (if given) runs under the engine
+    # lock so the caller's touched-set stays consistent with the bank the
+    # samples land in across a concurrent flush swap.
+
+    def _ingest_batch(self, slots, count, mark, apply):
+        with self.lock:
+            n = int(count if count is not None else len(slots))
+            if mark is not None:
+                mark(slots[:n])
+            self.samples_processed += n
+            apply(n)
+
+    def ingest_histo_batch(self, slots, values, weights, count=None,
+                           mark=None):
+        def apply(n):
+            self.histo_bank = tdigest.add_batch(
+                self.histo_bank, slots, values, weights,
+                compression=self.cfg.compression)
+        self._ingest_batch(slots, count, mark, apply)
+
+    def ingest_counter_batch(self, slots, values, weights, count=None,
+                             mark=None):
+        def apply(n):
+            self.counter_bank = scalar.counter_add(
+                self.counter_bank, slots, values, weights)
+        self._ingest_batch(slots, count, mark, apply)
+
+    def ingest_gauge_batch(self, slots, values, count=None, mark=None):
+        # Sequence numbers are assigned HERE (arrival order at the
+        # engine), not by the producer: the per-interval reset then
+        # happens under the same lock as the bank swap, so a stale
+        # pre-flush sample can never outrank a newer post-flush one and
+        # the counter cannot wrap within an interval.
+        def apply(n):
+            seqs = np.arange(1, len(slots) + 1, dtype=np.int32) \
+                + self._gauge_seq
+            self._gauge_seq += n
+            self.gauge_bank = scalar.gauge_set(
+                self.gauge_bank, slots, values, seqs)
+        self._ingest_batch(slots, count, mark, apply)
+
+    def ingest_set_batch(self, slots, reg_idx, rho, count=None, mark=None):
+        def apply(n):
+            self.set_bank = hll.insert(self.set_bank, slots, reg_idx, rho)
+        self._ingest_batch(slots, count, mark, apply)
+
     def process_event(self, ev):
         with self.lock:
             self._pending_events.append(ev)

@@ -47,7 +47,9 @@ class Server:
         self.cfg = cfg
         self.hostname = cfg.hostname or (
             "" if cfg.omit_empty_hostname else socket.gethostname())
-        n_workers = max(1, cfg.num_workers)
+        # Native ingest: the C++ bridge owns interning over ONE engine's
+        # slot space; its reader threads are the parallelism.
+        n_workers = 1 if cfg.native_ingest else max(1, cfg.num_workers)
         ecfg_kw = dict(
             histogram_slots=max(256, cfg.tpu_histogram_slots // n_workers),
             counter_slots=max(128, cfg.tpu_counter_slots // n_workers),
@@ -70,6 +72,10 @@ class Server:
                         for _ in range(n_workers)]
         self.worker_queues: list[queue.Queue] = [
             queue.Queue(maxsize=65536) for _ in range(n_workers)]
+        self.native_bridge = None
+        self.native_pump = None
+        if cfg.native_ingest:
+            self._setup_native_ingest()
         self.sinks = sinks if sinks is not None else self._sinks_from_config()
         self.plugins = plugins if plugins is not None else (
             [LocalFilePlugin(cfg.flush_file,
@@ -107,6 +113,45 @@ class Server:
                            else self._span_sinks_from_config())
 
     # ------------- construction helpers -------------
+
+    def _setup_native_ingest(self):
+        """Swap the single engine's KeyInterners for views over the C++
+        interning bridge, and build the pump that drains its sample
+        rings into the engine's batch kernels."""
+        from .ingest.native import BridgeKeyView, NativeBridge, NativePump
+
+        eng = self.engines[0]
+        ecfg = eng.cfg
+        self.native_bridge = NativeBridge(
+            histo_slots=ecfg.histogram_slots,
+            counter_slots=ecfg.counter_slots,
+            gauge_slots=ecfg.gauge_slots,
+            set_slots=ecfg.set_slots,
+            hll_precision=ecfg.hll_precision,
+            idle_ttl=ecfg.idle_ttl_intervals,
+            ring_capacity=self.cfg.native_ring_capacity,
+            max_packet=self.cfg.metric_max_length)
+        views = {b: BridgeKeyView(self.native_bridge, b)
+                 for b in ("histo", "counter", "gauge", "set")}
+        eng.histo_keys = views["histo"]
+        eng.counter_keys = views["counter"]
+        eng.gauge_keys = views["gauge"]
+        eng.set_keys = views["set"]
+
+        def slow_path(line: bytes):
+            """Lines the C++ parser routes to Python: events, service
+            checks, CPython-float oddities, invalid UTF-8."""
+            try:
+                item = parser.parse_packet(line)
+            except parser.ParseError:
+                with self._stats_lock:
+                    self.parse_errors += 1
+                return
+            self._route_metric(item)
+
+        self.native_pump = NativePump(
+            self.native_bridge, eng, views, slow_path,
+            batch=ecfg.batch_size)
 
     def _sinks_from_config(self) -> list[MetricSink]:
         out: list[MetricSink] = []
@@ -188,6 +233,8 @@ class Server:
         self._threads.append(t)
         if self.cfg.http_address:
             self._start_http_api(self.cfg.http_address)
+        if self.native_pump is not None:
+            self.native_pump.start()
         t = threading.Thread(target=self._flush_loop, name="flusher",
                              daemon=True)
         t.start()
@@ -233,6 +280,10 @@ class Server:
                 s.close()
             except OSError:
                 pass
+        if self.native_pump is not None:
+            self.native_pump.stop()
+        if self.native_bridge is not None:
+            self.native_bridge.stop()
         for s in self.sinks + self.span_sinks:
             try:
                 s.stop()
@@ -262,6 +313,16 @@ class Server:
         scheme, _, rest = addr.partition("://")
         if scheme in ("udp", "udp4", "udp6"):
             family, bind_addr = self._resolve_inet(scheme, rest)
+            if self.native_bridge is not None:
+                # the bridge only accepts numeric addresses; resolve
+                # hostnames here (the Python path's bind() would too)
+                host = socket.getaddrinfo(
+                    bind_addr[0], bind_addr[1], family,
+                    socket.SOCK_DGRAM)[0][4][0]
+                self.native_bridge.start_udp(
+                    host, bind_addr[1], max(1, self.cfg.num_readers),
+                    rcvbuf=self.cfg.read_buffer_size_bytes)
+                return
             for ri in range(max(1, self.cfg.num_readers)):
                 sock = socket.socket(family, socket.SOCK_DGRAM)
                 sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -452,6 +513,8 @@ class Server:
 
     def bound_port(self) -> int:
         """Port of the first UDP socket (for tests binding port 0)."""
+        if self.native_bridge is not None and not self._sockets:
+            return self.native_bridge.bound_port()
         return self._sockets[0].getsockname()[1]
 
     def _read_metric_socket(self, sock: socket.socket):
@@ -466,6 +529,11 @@ class Server:
             self.handle_packet(data)
 
     def handle_packet(self, data: bytes):
+        if self.native_bridge is not None:
+            # the bridge counts packets/errors itself; folded into
+            # self-metrics at flush
+            self.native_bridge.handle_packet(data)
+            return
         for line in data.split(b"\n"):
             if not line:
                 continue
@@ -511,6 +579,11 @@ class Server:
         unfinished-task accounting, so an item mid-`eng.process` still
         counts as in flight."""
         deadline = time.monotonic() + timeout
+        if self.native_pump is not None:
+            # bridge rings + slow path first; slow-path items land on the
+            # worker queues, which the loop below then settles
+            if not self.native_pump.drain(timeout):
+                return False
         queues = [self.span_queue] + self.worker_queues
         while True:
             if all(q.unfinished_tasks == 0 for q in queues):
@@ -576,6 +649,18 @@ class Server:
             drops, self.queue_drops = self.queue_drops, 0
             spans, self.spans_received = self.spans_received, 0
             sserrs, self.ssf_errors = self.ssf_errors, 0
+        if self.native_bridge is not None:
+            # UDP in native mode is counted in the bridge; fold in the
+            # per-interval deltas
+            st = self.native_bridge.stats()
+            last = getattr(self, "_last_bridge_stats", None) or {}
+            packets += int(st["packets"]) - int(last.get("packets", 0))
+            perrs += int(st["parse_errors"]) - int(
+                last.get("parse_errors", 0))
+            drops += (int(st["ring_drops"]) + int(st["drops_no_slot"])
+                      - int(last.get("ring_drops", 0))
+                      - int(last.get("drops_no_slot", 0)))
+            self._last_bridge_stats = st
         dur_ns = (time.monotonic() - t0) * 1e9
         mk = lambda name, value, mt: InterMetric(
             name=name, timestamp=ts, value=value, tags=[],
