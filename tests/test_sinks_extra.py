@@ -1,0 +1,300 @@
+"""Contract tests for the kafka / lightstep / newrelic / prometheus
+sinks and the s3 plugin — the sinks/*/ *_test.go strategy: loopback
+capture endpoints record request bodies; golden-shape assertions."""
+
+import gzip
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veneur_tpu.metrics import InterMetric, MetricType
+from veneur_tpu.sinks.kafka import KafkaMetricSink, KafkaSpanSink
+from veneur_tpu.sinks.lightstep import LightStepSpanSink
+from veneur_tpu.sinks.newrelic import NewRelicMetricSink
+from veneur_tpu.sinks.prometheus import PrometheusMetricSink, render
+from veneur_tpu.sinks.s3 import S3Plugin, object_key
+from veneur_tpu.ssf.protos import ssf_pb2
+
+
+def im(name, value, mtype=MetricType.GAUGE, tags=(), host="h"):
+    return InterMetric(name=name, timestamp=1000, value=value,
+                       tags=list(tags), type=mtype, hostname=host)
+
+
+def make_span(**kw):
+    defaults = dict(version=0, trace_id=7, id=8, parent_id=3,
+                    start_timestamp=1_000_000_000,
+                    end_timestamp=2_000_000_000, name="op", service="svc")
+    defaults.update(kw)
+    return ssf_pb2.SSFSpan(**defaults)
+
+
+class CaptureHTTP:
+    """Loopback http.server recording (path, headers, body)."""
+
+    def __init__(self):
+        self.requests = []
+        cap = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                cap.requests.append(
+                    (self.path, dict(self.headers), self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+# ---------------- kafka ----------------
+
+class TestKafka:
+    def test_metric_sink_produces_json(self):
+        produced = []
+        sink = KafkaMetricSink(
+            "broker:9092", "metrics",
+            producer=lambda t, k, v: produced.append((t, k, v)))
+        sink.start()
+        sink.flush([im("a.b", 1.5, tags=["x:1"]),
+                    im("c", 2, MetricType.COUNTER)])
+        assert len(produced) == 2
+        topic, key, value = produced[0]
+        assert topic == "metrics"
+        assert key == b"a.b|x:1"   # series identity partition key
+        body = json.loads(value)
+        assert body == {"name": "a.b", "timestamp": 1000, "value": 1.5,
+                        "tags": ["x:1"], "type": "gauge", "hostname": "h"}
+        assert json.loads(produced[1][2])["type"] == "counter"
+
+    def test_metric_sink_without_client_drops_counted(self):
+        sink = KafkaMetricSink("broker:9092", "metrics")
+        sink.start()  # no kafka lib in image -> producer None
+        sink.flush([im("a", 1), im("b", 2)])
+        assert sink.dropped_total == 2
+
+    def test_span_sink_protobuf_roundtrip(self):
+        produced = []
+        sink = KafkaSpanSink(
+            "broker:9092", "spans",
+            producer=lambda t, k, v: produced.append((t, k, v)))
+        sink.start()
+        sink.ingest(make_span())
+        sink.flush()
+        (topic, key, value), = produced
+        assert topic == "spans" and key == b"7"
+        got = ssf_pb2.SSFSpan()
+        got.ParseFromString(value)
+        assert got.trace_id == 7 and got.name == "op"
+
+    def test_span_sink_json(self):
+        produced = []
+        sink = KafkaSpanSink(
+            "b:9092", "spans", encoding="json",
+            producer=lambda t, k, v: produced.append(v))
+        sink.ingest(make_span(error=True))
+        sink.flush()
+        body = json.loads(produced[0])
+        assert body["trace_id"] == 7 and body["error"] is True
+
+    def test_span_buffer_cap(self):
+        sink = KafkaSpanSink("b", "t", producer=lambda *a: None,
+                             max_buffer=2)
+        for _ in range(5):
+            sink.ingest(make_span())
+        assert sink.dropped_total == 3
+
+
+# ---------------- lightstep ----------------
+
+class TestLightStep:
+    def test_report_shape(self):
+        cap = CaptureHTTP()
+        try:
+            sink = LightStepSpanSink("tok", collector_url=cap.url,
+                                     hostname="vh")
+            sink.ingest(make_span(tags={"k": "v"}))
+            sink.flush()
+            (path, _, body), = cap.requests
+            assert path == "/api/v0/reports"
+            rep = json.loads(body)
+            assert rep["auth"]["access_token"] == "tok"
+            rec, = rep["span_records"]
+            assert rec["trace_guid"] == "7"
+            assert rec["span_guid"] == "8"
+            assert rec["oldest_micros"] == 1_000_000
+            attrs = {a["Key"]: a["Value"] for a in rec["attributes"]}
+            assert attrs["parent_span_guid"] == "3"
+            assert attrs["k"] == "v"
+            assert sink.flushed_total == 1
+        finally:
+            cap.close()
+
+    def test_unreachable_collector_drops_counted(self):
+        sink = LightStepSpanSink("tok",
+                                 collector_url="http://127.0.0.1:1",
+                                 timeout_s=0.2)
+        sink.ingest(make_span())
+        sink.flush()
+        assert sink.dropped_total == 1
+
+    def test_empty_flush_no_post(self):
+        cap = CaptureHTTP()
+        try:
+            sink = LightStepSpanSink("tok", collector_url=cap.url)
+            sink.flush()
+            assert cap.requests == []
+        finally:
+            cap.close()
+
+
+# ---------------- newrelic ----------------
+
+class TestNewRelic:
+    def test_metric_payload(self):
+        cap = CaptureHTTP()
+        try:
+            sink = NewRelicMetricSink("key", account_id=42,
+                                      metric_url=cap.url,
+                                      event_url=cap.url,
+                                      tags=["env:prod"], interval_s=10)
+            sink.flush([im("lat.p50", 3.5, tags=["svc:web"]),
+                        im("hits", 7, MetricType.COUNTER)])
+            (path, headers, body), = cap.requests
+            assert path == "/metric/v1"
+            assert headers["Api-Key"] == "key"
+            (block,) = json.loads(body)
+            g, c = block["metrics"]
+            assert g == {"name": "lat.p50", "value": 3.5,
+                         "timestamp": 1000, "type": "gauge",
+                         "attributes": {"env": "prod", "svc": "web",
+                                        "hostname": "h"}}
+            assert c["type"] == "count" and c["interval.ms"] == 10000
+            assert sink.flushed_total == 2
+        finally:
+            cap.close()
+
+    def test_events(self):
+        from veneur_tpu.ingest.parser import Event, ServiceCheck
+        cap = CaptureHTTP()
+        try:
+            sink = NewRelicMetricSink("key", account_id=42,
+                                      metric_url=cap.url,
+                                      event_url=cap.url)
+            sink.flush_other(
+                [Event(title="deploy", text="v2", timestamp=5)],
+                [ServiceCheck(name="db", status=2, message="down")])
+            (path, _, body), = cap.requests
+            assert path == "/v1/accounts/42/events"
+            ev, chk = json.loads(body)
+            assert ev["eventType"] == "VeneurEvent"
+            assert ev["title"] == "deploy"
+            assert chk["eventType"] == "VeneurServiceCheck"
+            assert chk["status"] == 2
+        finally:
+            cap.close()
+
+
+# ---------------- prometheus ----------------
+
+class TestPrometheus:
+    def test_render_text_format(self):
+        text = render([im("api.req-time", 1.5, tags=["svc:a b"]),
+                       im("hits", 3, MetricType.COUNTER)])
+        assert "# TYPE api_req_time gauge" in text
+        assert 'api_req_time{svc="a b",hostname="h"} 1.5' in text
+        assert "# TYPE hits counter" in text
+
+    def test_counter_accumulates_across_flushes(self):
+        totals = {}
+        render([im("hits", 3, MetricType.COUNTER, host="")], totals)
+        text = render([im("hits", 4, MetricType.COUNTER, host="")], totals)
+        assert "hits 7" in text
+
+    def test_scrape_endpoint(self):
+        sink = PrometheusMetricSink("127.0.0.1:0")
+        sink.start()
+        try:
+            sink.flush([im("up.time", 9)])
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{sink.port}/metrics",
+                timeout=5).read().decode()
+            assert 'up_time{hostname="h"} 9' in body
+        finally:
+            sink.stop()
+
+
+# ---------------- s3 plugin ----------------
+
+class TestS3:
+    def test_uploads_gzipped_tsv(self):
+        uploads = []
+        plugin = S3Plugin("bkt", interval_s=10,
+                          uploader=lambda b, k, v: uploads.append(
+                              (b, k, v)))
+        plugin.flush([im("a.b", 1.5, tags=["x:1"])], "host1")
+        (bucket, key, body), = uploads
+        assert bucket == "bkt"
+        assert key.startswith("host1/") and key.endswith(".tsv.gz")
+        rows = gzip.decompress(body).decode().splitlines()
+        assert rows == ["a.b\tx:1\tgauge\th\t1000\t1.5\t10"]
+        assert plugin.uploaded_total == 1
+
+    def test_no_uploader_drops_counted(self):
+        plugin = S3Plugin("bkt")  # boto3 absent in image
+        plugin.flush([im("a", 1)], "host1")
+        assert plugin.dropped_total == 1
+
+    def test_failed_upload_counted_not_raised(self):
+        def boom(b, k, v):
+            raise RuntimeError("nope")
+        plugin = S3Plugin("bkt", uploader=boom)
+        plugin.flush([im("a", 1)], "h")
+        assert plugin.dropped_total == 1
+
+    def test_object_key_layout(self):
+        key = object_key("web-1", ts=time.mktime(
+            (2026, 7, 29, 12, 0, 0, 0, 0, 0)))
+        assert key.startswith("web-1/2026/")
+        assert key.endswith(".tsv.gz")
+
+
+# ---------------- config wiring ----------------
+
+class TestConfigWiring:
+    def test_server_builds_new_sinks(self):
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+
+        cfg = Config(statsd_listen_addresses=[], interval="10s",
+                     hostname="h",
+                     kafka_broker="b:9092", kafka_metric_topic="m",
+                     kafka_span_topic="s",
+                     newrelic_insert_key="k", newrelic_account_id=1,
+                     lightstep_access_token="tok",
+                     prometheus_repeater_address="127.0.0.1:0",
+                     aws_s3_bucket="bkt", flush_file="/tmp/x.tsv")
+        srv = Server(cfg)
+        names = sorted(s.name() for s in srv.sinks)
+        assert "kafka" in names and "newrelic" in names \
+            and "prometheus" in names
+        span_names = sorted(s.name() for s in srv.span_sinks)
+        assert "kafka" in span_names and "lightstep" in span_names
+        plugin_names = sorted(p.name() for p in srv.plugins)
+        assert plugin_names == ["localfile", "s3"]

@@ -96,6 +96,7 @@ class Config:
     newrelic_account_id: int = 0
     newrelic_insert_key: str = ""
     lightstep_access_token: str = ""
+    lightstep_collector_host: str = "https://collector.lightstep.com"
     xray_address: str = ""
     falconer_address: str = ""
     prometheus_repeater_address: str = ""

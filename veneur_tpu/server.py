@@ -77,10 +77,20 @@ class Server:
         if cfg.native_ingest:
             self._setup_native_ingest()
         self.sinks = sinks if sinks is not None else self._sinks_from_config()
-        self.plugins = plugins if plugins is not None else (
-            [LocalFilePlugin(cfg.flush_file,
-                             int(cfg.interval_seconds))]
-            if cfg.flush_file else [])
+        if plugins is not None:
+            self.plugins = plugins
+        else:
+            self.plugins = []
+            if cfg.flush_file:
+                self.plugins.append(LocalFilePlugin(
+                    cfg.flush_file, max(1, round(cfg.interval_seconds))))
+            if cfg.aws_s3_bucket:
+                from .sinks.s3 import S3Plugin
+                self.plugins.append(S3Plugin(
+                    bucket=cfg.aws_s3_bucket, region=cfg.aws_region,
+                    access_key=cfg.aws_access_key_id,
+                    secret_key=cfg.aws_secret_access_key,
+                    interval_s=max(1, round(cfg.interval_seconds))))
         if forwarder is None and cfg.forward_address:
             if cfg.forward_use_grpc:
                 from .cluster.forward import GrpcForwarder
@@ -163,7 +173,7 @@ class Server:
                 api_url=cfg.datadog_api_hostname,
                 hostname=self.hostname,
                 tags=list(cfg.tags),
-                interval_s=int(cfg.interval_seconds),
+                interval_s=max(1, round(cfg.interval_seconds)),
                 flush_max_per_body=cfg.datadog_flush_max_per_body))
         if cfg.signalfx_api_key:
             from .sinks.signalfx import SignalFxMetricSink
@@ -171,6 +181,22 @@ class Server:
                 api_key=cfg.signalfx_api_key,
                 endpoint=cfg.signalfx_endpoint_base,
                 hostname=self.hostname, tags=list(cfg.tags)))
+        if cfg.kafka_broker and (cfg.kafka_metric_topic or cfg.kafka_topic):
+            from .sinks.kafka import KafkaMetricSink
+            out.append(KafkaMetricSink(
+                broker=cfg.kafka_broker,
+                metric_topic=cfg.kafka_metric_topic or cfg.kafka_topic))
+        if cfg.newrelic_insert_key:
+            from .sinks.newrelic import NewRelicMetricSink
+            out.append(NewRelicMetricSink(
+                insert_key=cfg.newrelic_insert_key,
+                account_id=cfg.newrelic_account_id,
+                tags=list(cfg.tags),
+                interval_s=cfg.interval_seconds))
+        if cfg.prometheus_repeater_address:
+            from .sinks.prometheus import PrometheusMetricSink
+            out.append(PrometheusMetricSink(
+                listen_address=cfg.prometheus_repeater_address))
         if cfg.debug:
             out.append(DebugMetricSink())
         if not out:
@@ -197,6 +223,17 @@ class Server:
         if self.cfg.falconer_address:
             from .sinks.grpsink import GrpcSpanSink
             out.append(GrpcSpanSink(self.cfg.falconer_address))
+        if self.cfg.kafka_broker and self.cfg.kafka_span_topic:
+            from .sinks.kafka import KafkaSpanSink
+            out.append(KafkaSpanSink(
+                broker=self.cfg.kafka_broker,
+                span_topic=self.cfg.kafka_span_topic))
+        if self.cfg.lightstep_access_token:
+            from .sinks.lightstep import LightStepSpanSink
+            out.append(LightStepSpanSink(
+                access_token=self.cfg.lightstep_access_token,
+                collector_url=self.cfg.lightstep_collector_host,
+                hostname=self.hostname))
         if self.cfg.debug:
             from .sinks.basic import BlackholeSpanSink
             out.append(BlackholeSpanSink())
