@@ -90,18 +90,51 @@ _BETA14 = (-0.370393911, 0.070471823, 0.17393686, 0.16339839,
            -0.09237745, 0.03738027, -0.005384159, 0.00042419)
 
 
-@jax.jit
-def estimate(bank: HLLBank) -> jax.Array:
+def _use_pallas() -> bool:
+    """Run the streaming Pallas stats kernel on real TPUs (single-pass
+    HBM traffic over the u8 register file); plain jnp elsewhere.
+    VENEUR_TPU_NO_PALLAS=1 forces the jnp path."""
+    import os
+    if os.environ.get("VENEUR_TPU_NO_PALLAS", "") not in ("", "0"):
+        return False
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def estimate(bank: HLLBank, force_jnp: bool = False) -> jax.Array:
     """Batched cardinality estimate, one f32 per slot.
 
     LogLog-Beta estimator: m * alpha * (m - ez) / (beta(ez) + sum 2^-reg),
     with beta a degree-7 polynomial in ln(ez + 1). Valid across the whole
     range (no linear-counting switchover needed).
+
+    `force_jnp` pins the pure-jnp path — for callers tracing this inside
+    shard_map/pjit programs where the Pallas kernel isn't validated.
     """
-    m = bank.num_registers
+    if not force_jnp and _use_pallas() and bank.num_registers % 512 == 0:
+        return _estimate_pallas(bank)
+    return _estimate_jnp(bank)
+
+
+@jax.jit
+def _estimate_pallas(bank: HLLBank) -> jax.Array:
+    from .pallas_hll import hll_stats
+    ez, zsum = hll_stats(bank.registers)
+    return _estimate_from_stats(bank, ez, zsum)
+
+
+@jax.jit
+def _estimate_jnp(bank: HLLBank) -> jax.Array:
     regs = bank.registers.astype(jnp.float32)
     ez = jnp.sum(bank.registers == 0, axis=1).astype(jnp.float32)
     zsum = jnp.sum(jnp.exp2(-regs), axis=1)
+    return _estimate_from_stats(bank, ez, zsum)
+
+
+def _estimate_from_stats(bank: HLLBank, ez, zsum) -> jax.Array:
+    m = bank.num_registers
     zl = jnp.log(ez + 1.0)
     beta = ez * _BETA14[0]
     acc = zl
@@ -110,7 +143,8 @@ def estimate(bank: HLLBank) -> jax.Array:
         acc = acc * zl
     alpha = 0.7213 / (1.0 + 1.079 / m)
     est = alpha * m * (m - ez) / (beta + zsum)
-    return jnp.where(jnp.any(bank.registers > 0, axis=1), est, 0.0)
+    # ez == m  <=>  every register is zero  <=>  empty set
+    return jnp.where(ez < m, est, 0.0)
 
 
 def reset(bank: HLLBank) -> HLLBank:

@@ -184,7 +184,10 @@ class MeshEngine:
                 jnp.where((gb.seq == g_seq) & (g_seq >= 0), gb.value,
                           -jnp.inf), "dp")
             regs = jax.lax.pmax(sb.registers.astype(jnp.int32), "dp")
-            est = hll.estimate(hll.HLLBank(regs.astype(jnp.uint8)))
+            # force_jnp: this body is traced under shard_map, where the
+            # single-chip pallas fast path is not validated
+            est = hll.estimate(hll.HLLBank(regs.astype(jnp.uint8)),
+                               force_jnp=True)
             return q, agg, c_total, g_seq, g_val, est
 
         out_specs = (
