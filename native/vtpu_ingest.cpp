@@ -438,12 +438,19 @@ struct BankMeta {
 
 // ---------------------------------------------------------------- bridge
 
+constexpr int RING_WAYS = 8;  // sub-rings per bank: writers shard by
+                              // thread, so producers don't serialize
+                              // against each other or the drain memcpy
+
 struct Bridge {
   BankMeta banks[NUM_BANKS];
   Shard shards[NUM_SHARDS];
-  Ring rings[NUM_BANKS];
+  Ring rings[NUM_BANKS][RING_WAYS];
   int hll_precision = 14;
   int idle_ttl = 16;
+  // bumped on every advance_interval (evictions may reassign slots);
+  // thread-local key caches check it and self-invalidate
+  std::atomic<uint64_t> intern_epoch{0};
 
   std::mutex newkeys_mu;
   std::deque<NewKey> newkeys;
@@ -468,16 +475,27 @@ struct LocalStage {
   std::vector<std::pair<const uint8_t*, size_t>> secs, tags;
   ParsedMetric m;
   std::string keybuf;
+  // key -> slot memo, valid within one intern epoch: steady-state hot
+  // keys skip the sharded map (and its mutex) entirely
+  std::unordered_map<std::string, int32_t> key_cache[NUM_BANKS];
+  uint64_t cache_epoch = ~0ull;
   std::vector<int32_t> slots[NUM_BANKS];
   std::vector<float> a[NUM_BANKS];
   std::vector<float> b[NUM_BANKS];
   std::vector<int32_t> c[NUM_BANKS];
 
+  int way = -1;
+
   void flush(Bridge* br) {
+    if (way < 0) {
+      static std::atomic<int> next_way{0};
+      way = next_way.fetch_add(1, std::memory_order_relaxed) % RING_WAYS;
+    }
     for (int bk = 0; bk < NUM_BANKS; bk++) {
       if (!slots[bk].empty()) {
-        br->rings[bk].push(slots[bk].data(), a[bk].data(), b[bk].data(),
-                           c[bk].data(), slots[bk].size());
+        br->rings[bk][way].push(slots[bk].data(), a[bk].data(),
+                                b[bk].data(), c[bk].data(),
+                                slots[bk].size());
         slots[bk].clear();
         a[bk].clear();
         b[bk].clear();
@@ -487,21 +505,27 @@ struct LocalStage {
   }
 };
 
-int32_t intern_key(Bridge* br, const ParsedMetric& m, std::string* keybuf) {
-  int bk = bank_of(m.mtype);
-  BankMeta& bank = br->banks[bk];
-  Shard& sh = br->shards[m.digest & (NUM_SHARDS - 1)];
+inline void touch_meta(BankMeta& bank, int32_t slot, uint8_t scope);
+
+void build_key(const ParsedMetric& m, std::string* keybuf) {
   keybuf->clear();
   keybuf->append(m.name);
   keybuf->push_back('\x1f');
   keybuf->append(MTYPE_NAMES[m.mtype]);
   keybuf->push_back('\x1f');
   keybuf->append(m.joined_tags);
+}
+
+int32_t intern_key(Bridge* br, const ParsedMetric& m,
+                   const std::string& keybuf) {
+  int bk = bank_of(m.mtype);
+  BankMeta& bank = br->banks[bk];
+  Shard& sh = br->shards[m.digest & (NUM_SHARDS - 1)];
 
   int32_t slot;
   {
     std::lock_guard<std::mutex> g(sh.mu);
-    auto it = sh.map[bk].find(*keybuf);
+    auto it = sh.map[bk].find(keybuf);
     if (it != sh.map[bk].end()) {
       slot = it->second;
     } else {
@@ -514,7 +538,7 @@ int32_t intern_key(Bridge* br, const ParsedMetric& m, std::string* keybuf) {
         slot = bank.free_slots.back();
         bank.free_slots.pop_back();
       }
-      sh.map[bk].emplace(*keybuf, slot);
+      sh.map[bk].emplace(keybuf, slot);
       bank.key_count.fetch_add(1, std::memory_order_relaxed);
       NewKey nk;
       nk.bank = static_cast<uint8_t>(bk);
@@ -527,11 +551,20 @@ int32_t intern_key(Bridge* br, const ParsedMetric& m, std::string* keybuf) {
       br->newkeys.push_back(std::move(nk));
     }
   }
-  bank.last_interval[slot].store(
-      bank.interval.load(std::memory_order_relaxed),
-      std::memory_order_relaxed);
-  bank.scope[slot].store(m.scope, std::memory_order_relaxed);
+  touch_meta(bank, slot, m.scope);
   return slot;
+}
+
+// Refresh per-slot liveness/scope. Read-mostly: unconditional stores on
+// a hot slot ping-pong its cache line between reader cores; in steady
+// state the values don't change, so check first and only write on
+// difference.
+inline void touch_meta(BankMeta& bank, int32_t slot, uint8_t scope) {
+  uint32_t cur = bank.interval.load(std::memory_order_relaxed);
+  if (bank.last_interval[slot].load(std::memory_order_relaxed) != cur)
+    bank.last_interval[slot].store(cur, std::memory_order_relaxed);
+  if (bank.scope[slot].load(std::memory_order_relaxed) != scope)
+    bank.scope[slot].store(scope, std::memory_order_relaxed);
 }
 
 void route_other(Bridge* br, const uint8_t* line, size_t len) {
@@ -557,7 +590,22 @@ void handle_line(Bridge* br, LocalStage* st, const uint8_t* line,
     return;
   }
   const ParsedMetric& m = st->m;
-  int32_t slot = intern_key(br, m, &st->keybuf);
+  uint64_t ep = br->intern_epoch.load(std::memory_order_acquire);
+  if (st->cache_epoch != ep) {
+    for (auto& c : st->key_cache) c.clear();
+    st->cache_epoch = ep;
+  }
+  int cbk = bank_of(m.mtype);
+  build_key(m, &st->keybuf);
+  int32_t slot;
+  auto cit = st->key_cache[cbk].find(st->keybuf);
+  if (cit != st->key_cache[cbk].end()) {
+    slot = cit->second;
+    touch_meta(br->banks[cbk], slot, m.scope);
+  } else {
+    slot = intern_key(br, m, st->keybuf);
+    if (slot >= 0) st->key_cache[cbk].emplace(st->keybuf, slot);
+  }
   if (slot < 0) return;
   int bk = bank_of(m.mtype);
   br->samples.fetch_add(1, std::memory_order_relaxed);
@@ -651,7 +699,9 @@ void* vtpu_create(int32_t histo_slots, int32_t counter_slots,
                              set_slots};
   for (int i = 0; i < NUM_BANKS; i++) {
     br->banks[i].init(caps[i]);
-    br->rings[i].init(static_cast<size_t>(ring_capacity));
+    for (int w = 0; w < RING_WAYS; w++)
+      br->rings[i][w].init(
+          static_cast<size_t>(ring_capacity) / RING_WAYS + 1);
   }
   br->hll_precision = hll_precision;
   br->idle_ttl = idle_ttl;
@@ -753,8 +803,11 @@ void vtpu_stop(void* h) {
 int32_t vtpu_poll(void* h, int32_t bank, int32_t max_n, int32_t* slots,
                   float* a, float* b, int32_t* c) {
   Bridge* br = static_cast<Bridge*>(h);
-  return static_cast<int32_t>(
-      br->rings[bank].pop(slots, a, b, c, static_cast<size_t>(max_n)));
+  size_t got = 0;
+  for (int w = 0; w < RING_WAYS && got < static_cast<size_t>(max_n); w++)
+    got += br->rings[bank][w].pop(slots + got, a + got, b + got, c + got,
+                                  static_cast<size_t>(max_n) - got);
+  return static_cast<int32_t>(got);
 }
 
 // Drain newly-interned keys as packed records:
@@ -826,6 +879,9 @@ int32_t vtpu_advance_interval(void* h, int32_t bank) {
   Bridge* br = static_cast<Bridge*>(h);
   BankMeta& bm = br->banks[bank];
   uint32_t now = bm.interval.fetch_add(1, std::memory_order_relaxed) + 1;
+  // any eviction below may reassign slots: invalidate thread-local key
+  // caches up front (publishes before the frees become visible)
+  br->intern_epoch.fetch_add(1, std::memory_order_acq_rel);
   if (br->idle_ttl <= 0 || now < static_cast<uint32_t>(br->idle_ttl))
     return 0;
   uint32_t horizon = now - static_cast<uint32_t>(br->idle_ttl);
@@ -871,7 +927,8 @@ int32_t vtpu_intern(void* h, int32_t mtype, int32_t scope,
   hh = fnv1a_32(reinterpret_cast<const uint8_t*>(tn), strlen(tn), hh);
   hh = fnv1a_32(tags, static_cast<size_t>(tags_len), hh);
   m.digest = hh;
-  return intern_key(br, m, &keybuf);
+  build_key(m, &keybuf);
+  return intern_key(br, m, keybuf);
 }
 
 int64_t vtpu_key_count(void* h, int32_t bank) {
@@ -891,8 +948,10 @@ void vtpu_stats(void* h, uint64_t* out) {
   uint64_t no_slot = 0, ring_drops = 0;
   for (int i = 0; i < NUM_BANKS; i++) {
     no_slot += br->banks[i].drops_no_slot.load();
-    std::lock_guard<std::mutex> g(br->rings[i].mu);
-    ring_drops += br->rings[i].drops;
+    for (int w = 0; w < RING_WAYS; w++) {
+      std::lock_guard<std::mutex> g(br->rings[i][w].mu);
+      ring_drops += br->rings[i][w].drops;
+    }
   }
   out[5] = no_slot;
   out[6] = ring_drops;

@@ -372,53 +372,55 @@ class AggregationEngine:
                 np.fromiter(acc.values(), np.float32, len(acc)), seqs)
 
     def _flush_import_centroids(self):
-        """Merge staged foreign digests with the minimum number of
-        compress passes: one upfront (so buffer fill is known-zero), then
-        again only when some slot's buffered centroid count would exceed
-        the buffer depth — cost scales with imported data, not with K per
-        chunk."""
+        """Merge staged foreign digests in O(1) device calls: group the
+        interval's forwarded centroids per slot on host, pre-cluster each
+        slot's pile to <= C centroids with ONE batched cluster_rows
+        program, then land everything with one merge + one compress.
+        (The previous chunk-through-the-sample-buffer scheme cost a
+        compress round-trip per ~B centroids — dozens of dispatches for a
+        32-shard import; this is 3.)"""
         if not self._import_centroids:
             return
         items = self._import_centroids
         self._import_centroids = []
-        B = self.cfg.buffer_depth
         comp = self.cfg.compression
-        self.histo_bank = tdigest.compress(self.histo_bank, compression=comp)
+        C = self.histo_bank.num_centroids
 
-        pending: dict[int, int] = {}
-        batch: list = []
-
-        def emit():
-            if not batch:
-                return
-            self.histo_bank = tdigest.merge_centroids(
-                self.histo_bank,
-                np.concatenate([np.full(len(m), s, np.int32)
-                                for s, m, _ in batch]),
-                np.concatenate([m for _, m, _ in batch]),
-                np.concatenate([w for _, _, w in batch]))
-            batch.clear()
-
+        by_slot: dict[int, list] = {}
         for s, means, weights, *_ in items:
-            n = len(means)
-            if pending.get(s, 0) + n > B:
-                emit()
-                self.histo_bank = tdigest.compress(
-                    self.histo_bank, compression=comp)
-                pending.clear()
-            # a single digest larger than B (can't happen with matching
-            # compression, but forwarded payloads are untrusted) is
-            # split across compress passes
-            while n > B:
-                batch.append((s, means[:B], weights[:B]))
-                emit()
-                self.histo_bank = tdigest.compress(
-                    self.histo_bank, compression=comp)
-                means, weights = means[B:], weights[B:]
-                n = len(means)
-            batch.append((s, means, weights))
-            pending[s] = pending.get(s, 0) + n
-        emit()
+            by_slot.setdefault(s, []).append((means, weights))
+        slot_ids = np.fromiter(by_slot.keys(), np.int32, len(by_slot))
+        widths = [sum(len(m) for m, _ in piles)
+                  for piles in by_slot.values()]
+        W = max(128, int(np.ceil(max(widths) / 128.0) * 128))
+        S = len(slot_ids)
+        vals = np.zeros((S, W), np.float32)
+        wts = np.zeros((S, W), np.float32)
+        for row, piles in enumerate(by_slot.values()):
+            off = 0
+            for m, w in piles:
+                n = len(m)
+                vals[row, off:off + n] = m
+                wts[row, off:off + n] = w
+                off += n
+        cmeans, cwts = tdigest.cluster_rows(
+            vals, wts, compression=comp, num_centroids=C)
+        cmeans, cwts = np.asarray(cmeans), np.asarray(cwts)
+        # land the clustered centroids; merge_centroids drops on buffer
+        # overflow, so chunk the C columns to the buffer depth (one
+        # iteration in the default config where B >= C)
+        B = self.histo_bank.buf_size
+        for c0 in range(0, C, B):
+            chunk = slice(c0, min(C, c0 + B))
+            width = chunk.stop - chunk.start
+            self.histo_bank = tdigest.compress(self.histo_bank,
+                                               compression=comp)
+            rows = np.repeat(slot_ids, width)
+            self.histo_bank = tdigest.merge_centroids(
+                self.histo_bank, rows, cmeans[:, chunk].reshape(-1),
+                cwts[:, chunk].reshape(-1))
+        self.histo_bank = tdigest.compress(self.histo_bank,
+                                           compression=comp)
 
         sl = np.array([it[0] for it in items], np.int32)
         self.histo_bank = tdigest.merge_scalars(

@@ -118,10 +118,25 @@ def _compress_impl(bank: TDigestBank, compression: float) -> TDigestBank:
          (searchsorted per row) — no sequential per-digest loop remains.
     """
     K, C = bank.mean.shape
-    M = C + bank.buf_size
 
     vals = jnp.concatenate([bank.mean, bank.buf_value], axis=1)
     wts = jnp.concatenate([bank.weight, bank.buf_weight], axis=1)
+    new_mean, w_c = _cluster_core(vals, wts, compression, C)
+
+    return bank._replace(
+        mean=new_mean,
+        weight=w_c,
+        buf_value=jnp.zeros_like(bank.buf_value),
+        buf_weight=jnp.zeros_like(bank.buf_weight),
+        buf_n=jnp.zeros_like(bank.buf_n),
+    )
+
+
+def _cluster_core(vals, wts, compression: float, C: int):
+    """Greedy k1 clustering of arbitrary [K, M] (value, weight) rows into
+    at most C centroids per row — the shared core of compress and the
+    batched foreign-digest merge. Zero-weight entries are padding."""
+    K, M = vals.shape
     vals = jnp.where(wts > 0, vals, _INF)
 
     vals, wts = jax.lax.sort((vals, wts), dimension=-1, num_keys=1)
@@ -177,18 +192,26 @@ def _compress_impl(bank: TDigestBank, compression: float) -> TDigestBank:
     # The empties parked on cluster C-1 contributed weight 0, so no mask
     # fixup is needed; real data can also land on C-1 legitimately.
     new_mean = jnp.where(w_c > 0, wv_c / jnp.where(w_c > 0, w_c, 1.0), 0.0)
-
-    return bank._replace(
-        mean=new_mean,
-        weight=w_c,
-        buf_value=jnp.zeros_like(bank.buf_value),
-        buf_weight=jnp.zeros_like(bank.buf_weight),
-        buf_n=jnp.zeros_like(bank.buf_n),
-    )
+    return new_mean, w_c
 
 
 compress = partial(jax.jit, static_argnames=("compression",),
                    donate_argnames=("bank",))(_compress_impl)
+
+
+@partial(jax.jit, static_argnames=("compression", "num_centroids"))
+def cluster_rows(values, weights, compression: float = 100.0,
+                 num_centroids: int = 256):
+    """Cluster arbitrary padded centroid rows: f32[S, M] x2 ->
+    (means f32[S, C], weights f32[S, C]).
+
+    The batched foreign-digest merge for the global tier: a whole
+    interval's forwarded digests, grouped per slot and padded into one
+    matrix, collapse to <= C centroids per slot in ONE device program —
+    instead of squeezing thousands of digests through the B-sized sample
+    buffer with a compress pass per chunk (importsrv's Combine loop,
+    worker.go sym: Worker.ImportMetricGRPC, turned into a batch op)."""
+    return _cluster_core(values, weights, compression, num_centroids)
 
 
 def _add_batch_impl(bank: TDigestBank, slots, values, weights,
@@ -344,12 +367,50 @@ def quantile(bank: TDigestBank, qs) -> jax.Array:
     knot_v = jnp.concatenate([vmin, jnp.where(w > 0, means, vmax), vmax],
                              axis=1)
 
-    def interp_row(kq, kv, q):
-        return jnp.interp(q, kq, kv)
-
-    out = jax.vmap(interp_row, in_axes=(0, 0, None))(knot_q, knot_v, qs)
+    out = _interp_knots(knot_q, knot_v, qs)
     # Empty digests -> 0 (host layer skips unallocated slots anyway).
     return jnp.where(total > 0, out, 0.0)
+
+
+def _interp_knots(knot_q, knot_v, qs):
+    """Row-wise linear interpolation at qs over ascending knots —
+    [K, M] x [P] -> [K, P] — with NO gathers.
+
+    jnp.interp's searchsorted+gather lowers to a pathologically slow
+    per-element path under the SPMD partitioner (shard_map), which made
+    the mesh flush ~1000x slower than the single-chip program. Because
+    knot_q is ascending per row, `knot_q < q` is a prefix mask, so the
+    bracketing knots are the mask's last-True / first-False boundary
+    positions, recoverable with masked reductions (elementwise ops only —
+    partitioner-friendly on every path).
+    """
+    # Static unroll over the (small) P axis: keeping every intermediate
+    # [K, M] leaves M in the lane dimension — a [K, M, P] broadcast would
+    # put P (often 2-4) minor-most and waste 126/128 lanes per tile.
+    if qs.shape[0] == 0:
+        return jnp.zeros((knot_q.shape[0], 0), knot_q.dtype)
+    zero = jnp.zeros((), knot_q.dtype)
+    cols = []
+    for p in range(qs.shape[0]):
+        q = qs[p]
+        mask = knot_q < q                              # [K, M] prefix
+        nxt = jnp.concatenate(
+            [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
+        lo_b = mask & ~nxt                             # last True
+        prv = jnp.concatenate(
+            [jnp.ones_like(mask[:, :1]), mask[:, :-1]], axis=1)
+        hi_b = (~mask) & prv                           # first False
+        q_lo = jnp.sum(jnp.where(lo_b, knot_q, zero), axis=1)   # [K]
+        v_lo = jnp.sum(jnp.where(lo_b, knot_v, zero), axis=1)
+        q_hi = jnp.sum(jnp.where(hi_b, knot_q, zero), axis=1)
+        v_hi = jnp.sum(jnp.where(hi_b, knot_v, zero), axis=1)
+        denom = q_hi - q_lo
+        t = jnp.where(denom > 0,
+                      (q - q_lo) / jnp.where(denom > 0, denom, 1.0), 0.0)
+        out = v_lo + t * (v_hi - v_lo)
+        # q at/below the first knot: prefix mask empty -> first value
+        cols.append(jnp.where(jnp.any(mask, axis=1), out, knot_v[:, 0]))
+    return jnp.stack(cols, axis=1)
 
 
 @jax.jit

@@ -79,29 +79,82 @@ class MeshEngine:
         self.compression = compression
         self.buf_size = buf_size
         self.hll_precision = hll_precision
-        self.qs = jnp.asarray(percentiles, jnp.float32)
+        # kept as host numpy: device-array constants CLOSED OVER by a
+        # jitted function compile to a pathologically slow executable
+        # on the tunneled TPU backend (and poison later compiles in
+        # the process) — quantile targets are always passed as args
+        self.qs = np.asarray(percentiles, np.float32)
+        # One-device mesh: skip the partitioner entirely. All "dp"
+        # collectives are identities and shard_map/pjit-partitioned
+        # executables pay a large slow-path penalty on some backends
+        # (profiled ~1000x on a tunneled TPU) for zero benefit.
+        self._single = (self.D * self.S == 1)
         self._specs = None
         self.banks = self._init_banks()
-        self._ingest_fn = self._build_ingest()
-        self._flush_fn = self._build_flush()
+        if self._single:
+            self._ingest_fn = self._build_ingest_single()
+            self._flush_fn = self._build_flush_single()
+        else:
+            self._ingest_fn = self._build_ingest()
+            self._flush_fn = self._build_flush()
+        # Interval reset runs ON DEVICE (zeros materialize under the
+        # existing shardings): re-uploading fresh host banks every flush
+        # would move the whole state over PCIe/DCN each interval.
+        def _reset(b: MeshBanks) -> MeshBanks:
+            return MeshBanks(
+                histo=jax.tree.map(jnp.zeros_like, b.histo),
+                counter=jax.tree.map(jnp.zeros_like, b.counter),
+                # gauge seq sentinel is -1 ("never written"), not 0
+                gauge=scalar.GaugeBank(
+                    value=jnp.zeros_like(b.gauge.value),
+                    seq=jnp.full_like(b.gauge.seq, -1)),
+                sets=jax.tree.map(jnp.zeros_like, b.sets))
+
+        if self._single:
+            # out_shardings pinned to the device: bank pytrees coming out
+            # of jit would otherwise be "uncommitted", and executables
+            # recompiled against uncommitted inputs take a drastically
+            # slower path on the tunneled TPU backend (~1000x measured)
+            dev = self.mesh.devices.reshape(-1)[0]
+            sds = jax.sharding.SingleDeviceSharding(dev)
+            out_sh = jax.tree.map(lambda _: sds, self.banks)
+            self._reset_fn = jax.jit(_reset, donate_argnums=0,
+                                     out_shardings=out_sh)
+        else:
+            # out_shardings pinned: a plain jit would emit
+            # UnspecifiedValue shardings, and the NEXT ingest call would
+            # silently recompile its whole SPMD program every interval
+            shardings = jax.tree.map(
+                lambda spec: NamedSharding(self.mesh, spec), self._specs,
+                is_leaf=lambda x: isinstance(x, P))
+            self._reset_fn = jax.jit(_reset, donate_argnums=0,
+                                     out_shardings=shardings)
 
     # -------------- state --------------
 
-    def _init_banks(self) -> MeshBanks:
+    def _template_banks(self) -> MeshBanks:
         def rep(bank):
             return jax.tree.map(
                 lambda a: jnp.broadcast_to(a[None], (self.D,) + a.shape),
                 bank)
 
-        banks = MeshBanks(
+        return MeshBanks(
             histo=rep(tdigest.init(self.histogram_slots, self.compression,
                                    self.buf_size)),
             counter=rep(scalar.init_counters(self.counter_slots)),
             gauge=rep(scalar.init_gauges(self.gauge_slots)),
             sets=rep(hll.init(self.set_slots, self.hll_precision)),
         )
+
+    def _init_banks(self) -> MeshBanks:
+        banks = self._template_banks()
         if self._specs is None:
             self._specs = _bank_specs(banks)
+        if self._single:
+            # plain single-device placement — no NamedShardings, so every
+            # downstream jit compiles the fast unpartitioned executable
+            dev = self.mesh.devices.reshape(-1)[0]
+            return jax.tree.map(lambda a: jax.device_put(a, dev), banks)
         shardings = jax.tree.map(
             lambda spec: NamedSharding(self.mesh, spec), self._specs,
             is_leaf=lambda x: isinstance(x, P))
@@ -145,13 +198,74 @@ class MeshEngine:
             self.banks, h_slots, h_vals, h_wts, c_slots, c_vals, c_wts,
             g_slots, g_vals, g_seqs, s_slots, s_idx, s_rho)
 
+    # -------------- single-device fast paths --------------
+
+    def _build_ingest_single(self):
+        comp = self.compression
+
+        def step(banks, hs, hv, hw, cs, cv, cw, gs, gv, gq, ss, si, sr):
+            sq = lambda a: a[0]
+            ex = lambda a: a[None]
+            histo = tdigest._add_batch_impl(
+                jax.tree.map(sq, banks.histo), hs[0], hv[0], hw[0], comp)
+            counter = scalar.counter_add(
+                jax.tree.map(sq, banks.counter), cs[0], cv[0], cw[0])
+            gauge = scalar.gauge_set(
+                jax.tree.map(sq, banks.gauge), gs[0], gv[0], gq[0])
+            sets = hll.insert(
+                jax.tree.map(sq, banks.sets), ss[0], si[0], sr[0])
+            return MeshBanks(jax.tree.map(ex, histo),
+                             jax.tree.map(ex, counter),
+                             jax.tree.map(ex, gauge),
+                             jax.tree.map(ex, sets))
+
+        # committed outputs for the same reason as _reset_fn (see __init__)
+        dev = self.mesh.devices.reshape(-1)[0]
+        sds = jax.sharding.SingleDeviceSharding(dev)
+        out_sh = jax.tree.map(lambda _: sds, self.banks)
+        return jax.jit(step, donate_argnums=(0,), out_shardings=out_sh)
+
+    def _build_flush_single(self):
+        """D = S = 1: every "dp" collective is the identity, so the merged
+        flush is exactly the single-chip program."""
+        comp = self.compression
+
+        @jax.jit
+        def flush_one(banks: MeshBanks, qs):
+            sq = lambda a: a[0]
+            hb = tdigest._compress_impl(jax.tree.map(sq, banks.histo),
+                                        comp)
+            cb = jax.tree.map(sq, banks.counter)
+            gb = jax.tree.map(sq, banks.gauge)
+            sb = jax.tree.map(sq, banks.sets)
+            q = tdigest.quantile(hb, qs)
+            agg = tdigest.aggregates(hb)
+            est = hll.estimate(sb, force_jnp=True)
+            return (q, agg, cb.hi + cb.lo, gb.seq,
+                    jnp.where(gb.seq >= 0, gb.value, -jnp.inf), est)
+
+        return lambda banks: flush_one(banks, self.qs)
+
     # -------------- merged flush --------------
 
     def _build_flush(self):
-        comp = self.compression
-        qs = self.qs
+        """Two programs, deliberately split:
 
-        def per_instance(histo, counter, gauge, sets):
+        1. shard_map MERGE — everything that needs the "dp" collectives
+           (all_gather of centroids, psum/pmin/pmax of scalars, register
+           union). Outputs are the dp-merged, shard-sharded banks.
+        2. plain-jit EPILOGUE — quantile/aggregates/estimate over the
+           merged state. These are slot-parallel with no cross-shard
+           dependence, so XLA's automatic partitioning handles the
+           sharded inputs; keeping them OUT of shard_map matters because
+           several of their op compositions (sort feeding masked
+           reductions, closed-over scalar indexing) lower to a
+           pathologically slow path inside manually-partitioned regions
+           (~1000x on the TPU backend this was profiled on).
+        """
+        comp = self.compression
+
+        def merge(histo, counter, gauge, sets):
             sq = lambda a: a[0]
             hb = jax.tree.map(sq, histo)
             cb = jax.tree.map(sq, counter)
@@ -174,8 +288,6 @@ class MeshEngine:
                 recip=jax.lax.psum(hb.recip, "dp"),
             )
             merged = tdigest._compress_impl(merged, comp)
-            q = tdigest.quantile(merged, qs)
-            agg = tdigest.aggregates(merged)
 
             # ---- scalars / HLL: pure collectives ----
             c_total = jax.lax.psum(cb.hi + cb.lo, "dp")
@@ -184,34 +296,45 @@ class MeshEngine:
                 jnp.where((gb.seq == g_seq) & (g_seq >= 0), gb.value,
                           -jnp.inf), "dp")
             regs = jax.lax.pmax(sb.registers.astype(jnp.int32), "dp")
-            # force_jnp: this body is traced under shard_map, where the
-            # single-chip pallas fast path is not validated
-            est = hll.estimate(hll.HLLBank(regs.astype(jnp.uint8)),
-                               force_jnp=True)
-            return q, agg, c_total, g_seq, g_val, est
+            return merged, c_total, g_seq, g_val, regs
 
-        out_specs = (
-            P("shard", None),
-            {k: P("shard") for k in
-             ("min", "max", "sum", "count", "avg", "hmean")},
-            P("shard"), P("shard"), P("shard"), P("shard"),
-        )
+        bank_spec = TDigestBank(
+            mean=P("shard", None), weight=P("shard", None),
+            buf_value=P("shard", None), buf_weight=P("shard", None),
+            buf_n=P("shard"), vmin=P("shard"), vmax=P("shard"),
+            vsum=P("shard"), count=P("shard"), recip=P("shard"))
+        out_specs = (bank_spec, P("shard"), P("shard"), P("shard"),
+                     P("shard", None))
         # check_vma=False: outputs ARE dp-replicated (they come from
         # all_gather/psum/pmax over "dp"), but the varying-axes inference
         # can't prove it for all_gather-derived values.
-        shmapped = jax.shard_map(
-            per_instance, mesh=self.mesh,
+        merge_fn = jax.jit(jax.shard_map(
+            merge, mesh=self.mesh,
             in_specs=tuple(self._specs), out_specs=out_specs,
-            check_vma=False)
-        return jax.jit(shmapped)
+            check_vma=False))
+
+        @jax.jit
+        def epilogue(merged, regs, qs):
+            q = tdigest.quantile(merged, qs)
+            agg = tdigest.aggregates(merged)
+            est = hll.estimate(hll.HLLBank(regs.astype(jnp.uint8)),
+                               force_jnp=True)
+            return q, agg, est
+
+        def flush(banks):
+            merged, c_total, g_seq, g_val, regs = merge_fn(*banks)
+            q, agg, est = epilogue(merged, regs, self.qs)
+            return q, agg, c_total, g_seq, g_val, est
+
+        return flush
 
     def flush_merged(self):
         """Run the merged flush, reset state, return full-K host arrays."""
-        q, agg, c_total, g_seq, g_val, est = self._flush_fn(*self.banks)
+        q, agg, c_total, g_seq, g_val, est = self._flush_fn(self.banks)
         out = jax.device_get({
             "quantiles": q, "agg": agg, "counters": c_total,
             "gauge_seq": g_seq, "gauge_val": g_val, "set_est": est})
-        self.banks = self._init_banks()
+        self.banks = self._reset_fn(self.banks)
         return out
 
     # -------------- host-side batch routing helper --------------

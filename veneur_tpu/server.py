@@ -378,9 +378,101 @@ class Server:
                     name=f"udp-reader-{ri}", daemon=True)
                 t.start()
                 self._threads.append(t)
+        elif scheme in ("tcp", "tcp4", "tcp6", "unix"):
+            # statsd over streams (networking.go: StartStatsd's TCP/UNIX
+            # arms), newline-delimited; TLS (incl. mutual) when the
+            # config's tls_* triple is set
+            if scheme != "unix":
+                family, bind_addr = self._resolve_inet(scheme, rest)
+                lsock = socket.socket(family, socket.SOCK_STREAM)
+                lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                lsock.bind(bind_addr)
+            else:
+                if os.path.exists(rest):
+                    os.unlink(rest)
+                lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                lsock.bind(rest)
+            lsock.listen(128)
+            self._listen_socks.append(lsock)
+            ssl_ctx = self._tls_context() if scheme != "unix" else None
+            t = threading.Thread(
+                target=self._accept_statsd_streams, args=(lsock, ssl_ctx),
+                name=f"statsd-{scheme}-accept", daemon=True)
+            t.start()
+            self._threads.append(t)
         else:
-            raise ValueError(f"unsupported statsd listener {addr!r} "
-                             "(tcp/unix stream listeners arrive with SSF)")
+            raise ValueError(f"unsupported statsd listener {addr!r}")
+
+    def _tls_context(self):
+        """Server-side TLS from the config triple (networking.go: the
+        tls_key / tls_certificate pair enables TLS on TCP statsd;
+        tls_authority_certificate additionally demands client certs —
+        mutual TLS)."""
+        if not (self.cfg.tls_key and self.cfg.tls_certificate):
+            return None
+        import ssl
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile=self.cfg.tls_certificate,
+                            keyfile=self.cfg.tls_key)
+        if self.cfg.tls_authority_certificate:
+            ctx.load_verify_locations(
+                cafile=self.cfg.tls_authority_certificate)
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def _accept_statsd_streams(self, lsock: socket.socket, ssl_ctx):
+        while not self._stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                break
+            if ssl_ctx is not None:
+                try:
+                    conn = ssl_ctx.wrap_socket(conn, server_side=True)
+                except Exception:
+                    with self._stats_lock:
+                        self.parse_errors += 1
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
+            with self._conns_lock:
+                self._stream_conns.add(conn)
+            threading.Thread(target=self._read_statsd_stream, args=(conn,),
+                             name="statsd-stream", daemon=True).start()
+
+    def _read_statsd_stream(self, conn: socket.socket):
+        """Newline-delimited metric lines over a stream connection; a
+        line split across reads is reassembled."""
+        max_len = self.cfg.metric_max_length
+        tail = b""
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    try:
+                        data = conn.recv(65536)
+                    except OSError:
+                        return
+                    if not data:
+                        if tail:
+                            self.handle_packet(tail)
+                        return
+                    buf = tail + data
+                    nl = buf.rfind(b"\n")
+                    if nl < 0:
+                        tail = buf
+                        if len(tail) > max_len:
+                            # oversized garbage line: drop, count
+                            with self._stats_lock:
+                                self.parse_errors += 1
+                            tail = b""
+                        continue
+                    self.handle_packet(buf[:nl])
+                    tail = buf[nl + 1:]
+        finally:
+            with self._conns_lock:
+                self._stream_conns.discard(conn)
 
     def _start_ssf_listener(self, addr: str):
         """SSF ingest (Server.StartSSF): udp:// datagrams carry bare
