@@ -12,13 +12,10 @@ off the tunnel. Re-set the config here, before any backend initializes.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from veneur_tpu.utils.platform import pin_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+pin_cpu(8)

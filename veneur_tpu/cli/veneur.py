@@ -34,8 +34,8 @@ def main(argv=None):
         return 0
 
     if cfg.aggregation_backend == "cpu":
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from ..utils.platform import pin_cpu
+        pin_cpu()
 
     from ..server import Server
     srv = Server(cfg)

@@ -1,0 +1,38 @@
+"""Platform pinning for the image's sitecustomize axon-TPU trap.
+
+The driver image registers the tunneled "axon" PJRT plugin at interpreter
+boot (when cwd=/root/repo) and force-sets jax_platforms="axon,cpu" via
+jax.config — the JAX_PLATFORMS env var is overridden and cannot keep a
+process off the tunnel, which can hang for minutes. The only reliable
+defense is jax.config.update("jax_platforms", "cpu") after importing jax
+but before the first operation initializes a backend.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def pin_cpu(n_devices: int | None = None) -> None:
+    """Pin JAX to the host CPU platform; optionally request a virtual
+    n-device CPU mesh. Must run before any jax operation (backend init);
+    the device-count flag additionally requires that no XLA CPU client
+    exists yet in this process."""
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        opt = f"{_COUNT_FLAG}={n_devices}"
+        if _COUNT_FLAG in flags:
+            flags = re.sub(rf"{_COUNT_FLAG}=\d+", opt, flags)
+        else:
+            flags = (flags + " " + opt).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; caller's device check will see
