@@ -194,3 +194,40 @@ def test_slot_eviction_and_reuse():
     feed(eng, [b"c.new2:1|c"])
     res = eng.flush(timestamp=4)
     assert by_name(res.metrics)["c.new2"].value == 1.0
+
+
+def test_import_oversized_digest_is_bounded_and_accurate():
+    """A forwarded digest wider than the import cap must be pre-clustered
+    in bounded chunks (untrusted peers can't size device programs) and
+    still merge to accurate global percentiles."""
+    from veneur_tpu.models import pipeline as pl
+
+    rng = np.random.default_rng(7)
+    n = 3 * pl._IMPORT_W_CAP + 1234  # forces several pre-cluster chunks
+    data = rng.gamma(4.0, 25.0, n).astype(np.float32)
+
+    glob = AggregationEngine(small_config(
+        is_global=True, percentiles=(0.5, 0.99)))
+    key = parser.MetricKey("big.lat", "timer", "")
+    glob.import_histogram(
+        key, data, np.ones(n, np.float32),
+        float(data.min()), float(data.max()), float(data.sum()),
+        float(n), float((1.0 / data).sum()))
+    out = by_name(glob.flush(timestamp=10).metrics)
+
+    assert out["big.lat.count"].value == pytest.approx(n)
+    exact50, exact99 = np.quantile(data, [0.5, 0.99])
+    spread = data.max() - data.min()
+    assert abs(out["big.lat.50percentile"].value - exact50) < 0.01 * spread
+    assert abs(out["big.lat.99percentile"].value - exact99) < 0.01 * spread
+
+
+def test_single_column_histo_block_names_are_strings():
+    """Regression: a histogram block with exactly one column (no
+    percentiles, one aggregate) must still emit string metric names."""
+    eng = AggregationEngine(small_config(
+        percentiles=(), aggregates=("count",)))
+    feed(eng, [b"t.req:5|ms", b"t.req:7|ms"])
+    out = eng.flush(timestamp=1).metrics
+    assert [m.name for m in out] == ["t.req.count"]
+    assert out[0].value == pytest.approx(2.0)

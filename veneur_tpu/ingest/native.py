@@ -30,6 +30,7 @@ import threading
 import numpy as np
 
 from ..ingest.parser import MetricKey
+from ..models.worker import SlotInfo
 
 _BANKS = {"histo": 0, "counter": 1, "gauge": 2, "set": 3}
 _MTYPE_NAMES = ["counter", "gauge", "timer", "histogram", "set"]
@@ -324,6 +325,10 @@ class BridgeKeyView:
         self.mirror: dict[int, MetricKey] = {}
         self.touched = np.zeros(self.capacity, bool)
         self._scopes = np.zeros(self.capacity, np.uint8)
+        # Per-slot SlotInfo holders carrying the engine's flush
+        # presentation cache; replaced whenever the C++ interner
+        # reassigns a slot to a new key (register()).
+        self._holders: dict[int, SlotInfo] = {}
         self.dropped_no_slot = 0
 
     def __len__(self):
@@ -340,11 +345,15 @@ class BridgeKeyView:
         if slot < 0:
             self.dropped_no_slot += 1
             return -1
+        if self.mirror.get(slot) != key:
+            self._holders[slot] = SlotInfo(slot, 0, scope)
         self.mirror[slot] = key
         self.touched[slot] = True
         return slot
 
     def register(self, slot: int, key: MetricKey):
+        if self.mirror.get(slot) != key:
+            self._holders[slot] = SlotInfo(slot, 0, 0)
         self.mirror[slot] = key
 
     def mark(self, slots: np.ndarray):
@@ -362,10 +371,14 @@ class BridgeKeyView:
     def active_items(self):
         self.refresh_scopes()
         out = []
+        scopes = self._scopes
         for slot in np.nonzero(self.touched)[0].tolist():
             key = self.mirror.get(slot)
             if key is not None:
-                out.append((key, slot))
+                holder = self._holders.get(slot)
+                if holder is None:
+                    holder = self._holders[slot] = SlotInfo(slot, 0, 0)
+                out.append((key, slot, int(scopes[slot]), holder))
         return out
 
     def advance_interval(self):

@@ -34,10 +34,16 @@ import numpy as np
 
 from ..ingest.parser import (
     GLOBAL_ONLY, LOCAL_ONLY, MetricKey, UDPMetric)
-from ..metrics import InterMetric, MetricType
+from ..metrics import InterMetric, MetricFrame, MetricType
 from ..ops import hll, scalar, tdigest
 from ..utils import hashing
 from .worker import KeyInterner
+
+
+# Widest per-slot centroid pile the import path will hand to one device
+# program; wider (untrusted) forwarded digests are pre-clustered in
+# chunks of this size first.
+_IMPORT_W_CAP = 4096
 
 
 @dataclass
@@ -71,11 +77,24 @@ class ForwardExport:
     gauges: list = dc_field(default_factory=list)      # (key, value)
 
 
-@dataclass
 class FlushResult:
-    metrics: list
-    export: ForwardExport
-    stats: dict
+    """Flush output. `frame` is the columnar MetricFrame the engine
+    assembles (cheap); `metrics` materializes the InterMetric list from it
+    lazily, so callers that re-serialize anyway can consume the frame."""
+
+    __slots__ = ("frame", "export", "stats", "_metrics")
+
+    def __init__(self, frame=None, export=None, stats=None, metrics=None):
+        self.frame = frame
+        self.export = export if export is not None else ForwardExport()
+        self.stats = stats if stats is not None else {}
+        self._metrics = metrics
+
+    @property
+    def metrics(self) -> list:
+        if self._metrics is None:
+            self._metrics = self.frame.to_list() if self.frame else []
+        return self._metrics
 
 
 class _Stage:
@@ -154,6 +173,25 @@ class AggregationEngine:
         # %g formatting matches veneur's suffixes ("99percentile",
         # "99.9percentile") and avoids int() truncation (0.29 -> 28).
         self._pct_names = [f".{p * 100:g}percentile" for p in cfg.percentiles]
+        # Flush-assembly presentation caches: per-key metric names and
+        # split tag lists are immutable across flushes, so they're built
+        # once and re-used; the columnar frame then only moves numpy
+        # values. Bounded (cleared when oversized) because the native
+        # bridge's interner evicts keys without telling us.
+        self._pct_sufs = list(self._pct_names)
+        if self._median_idx is not None:
+            self._pct_sufs.append(".median")
+        self._agg_emit = [a for a in cfg.aggregates
+                          if a in ("min", "max", "sum", "count",
+                                   "avg", "hmean")]
+        agg_types = tuple(MetricType.COUNTER if a == "count"
+                          else MetricType.GAUGE for a in self._agg_emit)
+        self._histo_full_types = (
+            (MetricType.GAUGE,) * len(self._pct_sufs) + agg_types)
+        self._histo_agg_types = agg_types
+        self._tags_cache: dict[str, list] = {}
+        self._pres_bound = 4 * (cfg.histogram_slots + cfg.counter_slots
+                                + cfg.gauge_slots + cfg.set_slots)
         self.samples_processed = 0
         # Imported (Combine) staging for the global tier — everything is
         # batched so a 32-shard import costs a handful of device calls,
@@ -389,6 +427,46 @@ class AggregationEngine:
         by_slot: dict[int, list] = {}
         for s, means, weights, *_ in items:
             by_slot.setdefault(s, []).append((means, weights))
+
+        # Forwarded payloads are untrusted: a digest with millions of
+        # centroids must not size the [S, W] device matrix (resource
+        # exhaustion + a fresh XLA compile per W bucket). Pre-cluster any
+        # oversized pile in fixed-width chunks — each pass reduces a chunk
+        # of `cap` raw centroids to C clustered ones, so with cap >= 2C
+        # the loop converges geometrically and every program shape stays
+        # bounded (cap must exceed C or re-chunking could never shrink a
+        # pile at high compression settings).
+        cap = max(_IMPORT_W_CAP, 2 * C)
+        while True:
+            oversized = [
+                s for s, piles in by_slot.items()
+                if sum(len(m) for m, _ in piles) > cap]
+            if not oversized:
+                break
+            owners, chunks_v, chunks_w = [], [], []
+            for s in oversized:
+                piles = by_slot[s]
+                m = np.concatenate([np.asarray(p[0], np.float32)
+                                    for p in piles])
+                w = np.concatenate([np.asarray(p[1], np.float32)
+                                    for p in piles])
+                for i in range(0, len(m), cap):
+                    cv = np.zeros(cap, np.float32)
+                    cw = np.zeros(cap, np.float32)
+                    seg = slice(i, min(len(m), i + cap))
+                    cv[:seg.stop - seg.start] = m[seg]
+                    cw[:seg.stop - seg.start] = w[seg]
+                    owners.append(s)
+                    chunks_v.append(cv)
+                    chunks_w.append(cw)
+                by_slot[s] = []
+            cm, cw = tdigest.cluster_rows(
+                np.stack(chunks_v), np.stack(chunks_w),
+                compression=comp, num_centroids=C)
+            cm, cw = np.asarray(cm), np.asarray(cw)
+            for row, s in enumerate(owners):
+                by_slot[s].append((cm[row], cw[row]))
+
         slot_ids = np.fromiter(by_slot.keys(), np.int32, len(by_slot))
         widths = [sum(len(m) for m, _ in piles)
                   for piles in by_slot.values()]
@@ -442,6 +520,7 @@ class AggregationEngine:
         immutable snapshot while ingest continues into fresh banks."""
         ts = int(timestamp if timestamp is not None else time.time())
         cfg = self.cfg
+        t_start = time.perf_counter()
         with self.lock:
             self.drain_all()
             self._flush_import_centroids()
@@ -458,14 +537,10 @@ class AggregationEngine:
             self.set_bank = hll.reset(sb)
             self._gauge_seq = 0
             active = {
-                "histo": [(k, s, self.histo_keys.scope_of(s))
-                          for k, s in self.histo_keys.active_items()],
-                "counter": [(k, s, self.counter_keys.scope_of(s))
-                            for k, s in self.counter_keys.active_items()],
-                "gauge": [(k, s, self.gauge_keys.scope_of(s))
-                          for k, s in self.gauge_keys.active_items()],
-                "set": [(k, s, self.set_keys.scope_of(s))
-                        for k, s in self.set_keys.active_items()],
+                "histo": self.histo_keys.active_items(),
+                "counter": self.counter_keys.active_items(),
+                "gauge": self.gauge_keys.active_items(),
+                "set": self.set_keys.active_items(),
             }
             stats_samples = self.samples_processed
             self.samples_processed = 0
@@ -479,89 +554,191 @@ class AggregationEngine:
                        self.gauge_keys, self.set_keys):
                 ki.advance_interval()
 
+        t_swap = time.perf_counter()
+
+        # Forwarding is the only consumer of the raw centroid matrices and
+        # HLL registers; when it's off (or this is the global tier), skip
+        # fetching them — at 100k slots they dominate transfer time.
+        fwd_out = cfg.forward_enabled and not cfg.is_global
         hb = tdigest.compress(hb, compression=cfg.compression)
         device = {
             "q": tdigest.quantile(hb, self._qs),
             "agg": tdigest.aggregates(hb),
-            "h_mean": hb.mean, "h_weight": hb.weight,
-            "h_min": hb.vmin, "h_max": hb.vmax, "h_sum": hb.vsum,
-            "h_count": hb.count, "h_recip": hb.recip,
             "c_hi": cb.hi, "c_lo": cb.lo,
             "g_value": gb.value, "g_seq": gb.seq,
             "s_est": hll.estimate(sb),
-            "s_regs": sb.registers,
         }
+        if fwd_out:
+            device.update(
+                h_mean=hb.mean, h_weight=hb.weight,
+                h_min=hb.vmin, h_max=hb.vmax, h_sum=hb.vsum,
+                h_count=hb.count, h_recip=hb.recip,
+                s_regs=sb.registers)
         host = jax.device_get(device)
+        t_device = time.perf_counter()
 
-        out: list[InterMetric] = []
+        frame = MetricFrame(ts, cfg.hostname)
         export = ForwardExport()
-        fwd = cfg.forward_enabled
-
-        def emit(key, suffix, value, mtype):
-            tags = key.joined_tags.split(",") if key.joined_tags else []
-            out.append(InterMetric(
-                name=key.name + suffix, timestamp=ts, value=float(value),
-                tags=tags, type=mtype, hostname=cfg.hostname))
-
         agg = host["agg"]
-        for key, slot, scope in active["histo"]:
-            if float(agg["count"][slot]) <= 0:
-                continue
-            forward_it = fwd and scope != LOCAL_ONLY
-            local_full = (not fwd) or scope == LOCAL_ONLY or cfg.is_global
-            if forward_it and not cfg.is_global:
-                w = host["h_weight"][slot]
-                nz = w > 0
-                export.histograms.append((
-                    key, host["h_mean"][slot][nz], w[nz],
-                    float(host["h_min"][slot]), float(host["h_max"][slot]),
-                    float(host["h_sum"][slot]),
-                    float(host["h_count"][slot]),
-                    float(host["h_recip"][slot])))
-                if scope == GLOBAL_ONLY:
-                    continue
-            if local_full:
-                for pi, pname in enumerate(self._pct_names):
-                    emit(key, pname, host["q"][slot][pi], MetricType.GAUGE)
-                if self._median_idx is not None:
-                    emit(key, ".median", host["q"][slot][self._median_idx],
-                         MetricType.GAUGE)
-            for name in cfg.aggregates:
-                if name in agg:
-                    val = agg[name][slot]
-                    mt = (MetricType.COUNTER if name == "count"
-                          else MetricType.GAUGE)
-                    emit(key, f".{name}", val, mt)
 
-        for key, slot, scope in active["counter"]:
-            total = float(host["c_hi"][slot]) + float(host["c_lo"][slot])
-            if fwd and scope == GLOBAL_ONLY and not cfg.is_global:
-                export.counters.append((key, total))
+        # ---- histograms: vectorized gathers over the active set ----
+        infos = active["histo"]
+        if infos:
+            n = len(infos)
+            slots = np.fromiter((t[1] for t in infos), np.int64, n)
+            scopes = np.fromiter((t[2] for t in infos), np.int64, n)
+            live = np.asarray(agg["count"])[slots] > 0
+            if fwd_out:
+                exp_m = live & (scopes != LOCAL_ONLY)
+                full_m = live & (scopes == LOCAL_ONLY)
+                aggonly_m = exp_m & (scopes != GLOBAL_ONLY)
+                for i in np.nonzero(exp_m)[0].tolist():
+                    key, slot = infos[i][0], infos[i][1]
+                    w = host["h_weight"][slot]
+                    nz = w > 0
+                    export.histograms.append((
+                        key, host["h_mean"][slot][nz], w[nz],
+                        float(host["h_min"][slot]),
+                        float(host["h_max"][slot]),
+                        float(host["h_sum"][slot]),
+                        float(host["h_count"][slot]),
+                        float(host["h_recip"][slot])))
             else:
-                emit(key, "", total, MetricType.COUNTER)
-
-        for key, slot, scope in active["gauge"]:
-            if host["g_seq"][slot] < 0:
-                continue
-            val = float(host["g_value"][slot])
-            if fwd and scope == GLOBAL_ONLY and not cfg.is_global:
-                export.gauges.append((key, val))
+                full_m = live
+                aggonly_m = None
+            qmat = np.asarray(host["q"], np.float64)
+            if self._agg_emit:
+                aggmat = np.stack(
+                    [np.asarray(agg[a], np.float64)
+                     for a in self._agg_emit], axis=1)
             else:
-                emit(key, "", val, MetricType.GAUGE)
+                aggmat = np.zeros((qmat.shape[0], 0), np.float64)
 
-        for key, slot, scope in active["set"]:
-            forward_it = fwd and scope != LOCAL_ONLY and not cfg.is_global
-            if forward_it:
-                export.sets.append((key, host["s_regs"][slot]))
+            idx = np.nonzero(full_m)[0].tolist()
+            if idx:
+                pres = [self._histo_pres_of(infos[i]) for i in idx]
+                frame.add_block(
+                    [p[0] for p in pres], [p[2] for p in pres],
+                    np.concatenate(
+                        [qmat[slots[idx]], aggmat[slots[idx]]], axis=1),
+                    self._histo_full_types)
+            if aggonly_m is not None and self._agg_emit:
+                idx = np.nonzero(aggonly_m)[0].tolist()
+                if idx:
+                    pres = [self._histo_pres_of(infos[i]) for i in idx]
+                    frame.add_block(
+                        [p[1] for p in pres], [p[2] for p in pres],
+                        aggmat[slots[idx]], self._histo_agg_types)
+
+        # ---- counters ----
+        infos = active["counter"]
+        if infos:
+            n = len(infos)
+            slots = np.fromiter((t[1] for t in infos), np.int64, n)
+            totals = (np.asarray(host["c_hi"], np.float64)
+                      + np.asarray(host["c_lo"], np.float64))[slots]
+            keep = range(n)
+            if fwd_out:
+                scopes = np.fromiter((t[2] for t in infos), np.int64, n)
+                gm = scopes == GLOBAL_ONLY
+                for i in np.nonzero(gm)[0].tolist():
+                    export.counters.append((infos[i][0], float(totals[i])))
+                keep = np.nonzero(~gm)[0].tolist()
+            keep = list(keep)
+            if keep:
+                frame.add_block(
+                    [infos[i][0].name for i in keep],
+                    [self._scalar_tags_of(infos[i]) for i in keep],
+                    totals[keep], (MetricType.COUNTER,))
+
+        # ---- gauges ----
+        infos = active["gauge"]
+        if infos:
+            n = len(infos)
+            slots = np.fromiter((t[1] for t in infos), np.int64, n)
+            live = np.asarray(host["g_seq"])[slots] >= 0
+            vals = np.asarray(host["g_value"], np.float64)[slots]
+            if fwd_out:
+                scopes = np.fromiter((t[2] for t in infos), np.int64, n)
+                gm = live & (scopes == GLOBAL_ONLY)
+                for i in np.nonzero(gm)[0].tolist():
+                    export.gauges.append((infos[i][0], float(vals[i])))
+                keep = np.nonzero(live & ~gm)[0].tolist()
             else:
-                emit(key, "", host["s_est"][slot], MetricType.GAUGE)
+                keep = np.nonzero(live)[0].tolist()
+            if keep:
+                frame.add_block(
+                    [infos[i][0].name for i in keep],
+                    [self._scalar_tags_of(infos[i]) for i in keep],
+                    vals[keep], (MetricType.GAUGE,))
 
+        # ---- sets ----
+        infos = active["set"]
+        if infos:
+            n = len(infos)
+            slots = np.fromiter((t[1] for t in infos), np.int64, n)
+            ests = np.asarray(host["s_est"], np.float64)[slots]
+            keep = range(n)
+            if fwd_out:
+                scopes = np.fromiter((t[2] for t in infos), np.int64, n)
+                fm = scopes != LOCAL_ONLY
+                for i in np.nonzero(fm)[0].tolist():
+                    export.sets.append(
+                        (infos[i][0], host["s_regs"][infos[i][1]]))
+                keep = np.nonzero(~fm)[0].tolist()
+            keep = list(keep)
+            if keep:
+                frame.add_block(
+                    [infos[i][0].name for i in keep],
+                    [self._scalar_tags_of(infos[i]) for i in keep],
+                    ests[keep], (MetricType.GAUGE,))
+
+        t_end = time.perf_counter()
         stats = {
             "samples": stats_samples,
             "histo_keys": histo_key_count,
             "dropped_no_slot": dropped,
+            # Flush phase durations (veneur's flush.*_duration_ns
+            # self-metrics; flusher.go sym: Server.Flush spans).
+            "swap_ns": int((t_swap - t_start) * 1e9),
+            "merge_ns": int((t_device - t_swap) * 1e9),
+            "assembly_ns": int((t_end - t_device) * 1e9),
         }
-        return FlushResult(metrics=out, export=export, stats=stats)
+        return FlushResult(frame=frame, export=export, stats=stats)
+
+    # ---- presentation caches (names/tags reused across flushes) ----
+    # Cached on the interner's per-key SlotInfo holder: a plain attribute
+    # read per key instead of a MetricKey hash, and the cache dies with
+    # the entry on eviction. The joined-tags split is additionally shared
+    # across keys (many keys carry identical tag sets).
+
+    def _tags_of(self, joined: str) -> list:
+        tl = self._tags_cache.get(joined)
+        if tl is None:
+            if len(self._tags_cache) > self._pres_bound:
+                self._tags_cache.clear()
+            tl = joined.split(",") if joined else []
+            self._tags_cache[joined] = tl
+        return tl
+
+    def _scalar_tags_of(self, info) -> list:
+        holder = info[3]
+        tl = holder.pres
+        if tl is None:
+            tl = holder.pres = self._tags_of(info[0].joined_tags)
+        return tl
+
+    def _histo_pres_of(self, info) -> tuple:
+        holder = info[3]
+        pr = holder.pres
+        if pr is None:
+            key = info[0]
+            nm = key.name
+            full = tuple([nm + s for s in self._pct_sufs]
+                         + [f"{nm}.{a}" for a in self._agg_emit])
+            pr = holder.pres = (full, full[len(self._pct_sufs):],
+                                self._tags_of(key.joined_tags))
+        return pr
 
     def drain_events(self):
         with self.lock:

@@ -19,6 +19,9 @@ class SlotInfo:
     slot: int
     last_interval: int
     scope: int
+    # Flush presentation cache (per-key metric names / split tag lists),
+    # owned by the engine's assembly; dies with the entry on eviction.
+    pres: object = None
 
 
 class KeyInterner:
@@ -65,11 +68,13 @@ class KeyInterner:
         return self._map[key].scope if key is not None else 0
 
     def active_items(self):
-        """(key, slot) pairs touched in the *current* interval — the set a
-        flush reports (bank state is interval-scoped, so stale slots hold
-        zeros and are skipped)."""
+        """(key, slot, scope, info) tuples for keys touched in the
+        *current* interval — the set a flush reports (bank state is
+        interval-scoped, so stale slots hold zeros and are skipped).
+        Returning scope and the SlotInfo directly spares the flush a
+        per-key MetricKey hash (scope_of) at 100k keys."""
         cur = self.interval
-        return [(k, i.slot) for k, i in self._map.items()
+        return [(k, i.slot, i.scope, i) for k, i in self._map.items()
                 if i.last_interval == cur]
 
     def advance_interval(self):
