@@ -1,0 +1,78 @@
+"""Histogram scalar precision: 2Sum-compensated vsum/count/recip.
+
+The reference accumulates Histo's exact stats in float64
+(samplers/samplers.go sym: Histo.Sample; tdigest/merging_digest.go keeps
+float64 throughout). Plain f32 stalls at 2^24 (16.7M + 1 == 16.7M), which
+a hot timer at north-star rates (10M weighted samples/interval on one
+key) hits within two intervals. The bank therefore carries (hi, lo) 2Sum
+pairs for vsum/count/recip — same scheme as the counter bank — and exact
+totals are float64(hi) + float64(lo) on host (ops/tdigest.py).
+"""
+
+import numpy as np
+
+from veneur_tpu.ingest.parser import MetricKey
+from veneur_tpu.metrics import MetricType
+from veneur_tpu.models.pipeline import AggregationEngine, EngineConfig
+from veneur_tpu.ops import tdigest
+
+# 10 batches x 8192 samples x weight 256 land exactly (every partial sum
+# is a multiple of 256 below 2^24-scale spacing), then one final weight-1
+# sample pushes the total to an ODD value above 2^24 — unrepresentable in
+# any single f32, so only the hi/lo pair can hold it.
+BATCH = 8192
+W = 256.0
+N_BATCHES = 10
+EXPECT = N_BATCHES * BATCH * int(W) + 1  # 20,971,521 (odd, > 2^24)
+
+
+def _exact(hi, lo, slot=0):
+    return float(np.float64(np.asarray(hi)[slot])
+                 + np.float64(np.asarray(lo)[slot]))
+
+
+def test_bank_count_and_sum_exact_past_2_24():
+    bank = tdigest.init(8, compression=100.0, buf_size=64)
+    slots = np.zeros(BATCH, np.int32)
+    values = np.ones(BATCH, np.float32)
+    for _ in range(N_BATCHES):
+        bank = tdigest.add_batch(
+            bank, slots, values, np.full(BATCH, W, np.float32),
+            compression=100.0)
+    one = np.full(BATCH, -1, np.int32)
+    one[0] = 0
+    bank = tdigest.add_batch(
+        bank, one, values, np.ones(BATCH, np.float32), compression=100.0)
+
+    assert _exact(bank.count, bank.count_lo) == float(EXPECT)
+    # values are all 1.0, so the weighted sum equals the count
+    total = _exact(bank.vsum, bank.vsum_lo)
+    assert abs(total - EXPECT) / EXPECT < 1e-6
+    recip = _exact(bank.recip, bank.recip_lo)
+    assert abs(recip - EXPECT) / EXPECT < 1e-6
+    # plain f32 provably cannot represent the total — guards against a
+    # regression that folds the pair back into a single float on device
+    assert float(np.float32(EXPECT)) != float(EXPECT)
+
+
+def test_engine_flush_emits_exact_count_aggregate():
+    eng = AggregationEngine(EngineConfig(
+        histogram_slots=8, counter_slots=8, gauge_slots=8, set_slots=8,
+        buffer_depth=64, percentiles=(0.5,),
+        aggregates=("count", "sum")))
+    key = MetricKey("hot.timer", "timer", "")
+    slot = eng.histo_keys.lookup(key, 0)
+    slots = np.full(BATCH, slot, np.int32)
+    values = np.ones(BATCH, np.float32)
+    for _ in range(N_BATCHES):
+        eng.ingest_histo_batch(slots, values,
+                               np.full(BATCH, W, np.float32))
+    one = np.full(BATCH, -1, np.int32)
+    one[0] = slot
+    eng.ingest_histo_batch(one, values, np.ones(BATCH, np.float32))
+
+    by_name = {m.name: m for m in eng.flush(timestamp=1).metrics}
+    cnt = by_name["hot.timer.count"]
+    assert cnt.type == MetricType.COUNTER
+    assert cnt.value == float(EXPECT)
+    assert abs(by_name["hot.timer.sum"].value - EXPECT) / EXPECT < 1e-6

@@ -19,6 +19,14 @@ State layout (per bank):
   vmin, vmax : f32[K]        exact extremes (+inf / -inf when empty)
   vsum, count, recip : f32[K]  sample-rate-weighted sum / count / sum(w/v)
                                (recip backs the `hmean` aggregate)
+  vsum_lo, count_lo, recip_lo : f32[K]  2Sum compensation terms: a hot
+                               timer at north-star rates pushes >2^24
+                               samples through one slot per interval,
+                               saturating plain f32; each batch folds its
+                               dense delta into the (hi, lo) pair with an
+                               error-free transformation, exactly like the
+                               counter bank (scalar.py). Exact totals are
+                               float64(hi) + float64(lo) on host.
 
 Semantics parity notes:
   * Sample weight = 1/sample_rate, matching Histo.Sample's weight handling.
@@ -44,6 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from . import scatter
+from .scalar import _two_sum
 
 _INF = jnp.inf
 
@@ -59,6 +68,9 @@ class TDigestBank(NamedTuple):
     vsum: jax.Array        # f32[K]
     count: jax.Array       # f32[K]
     recip: jax.Array       # f32[K]
+    vsum_lo: jax.Array     # f32[K] 2Sum compensation for vsum
+    count_lo: jax.Array    # f32[K] 2Sum compensation for count
+    recip_lo: jax.Array    # f32[K] 2Sum compensation for recip
 
     @property
     def num_slots(self):
@@ -95,6 +107,9 @@ def init(num_slots: int, compression: float = 100.0, buf_size: int = 256,
         vsum=jnp.zeros((k,), dtype),
         count=jnp.zeros((k,), dtype),
         recip=jnp.zeros((k,), dtype),
+        vsum_lo=jnp.zeros((k,), dtype),
+        count_lo=jnp.zeros((k,), dtype),
+        recip_lo=jnp.zeros((k,), dtype),
     )
 
 
@@ -233,14 +248,22 @@ def _add_batch_impl(bank: TDigestBank, slots, values, weights,
     sd = jnp.where(valid, s, K)  # OOB -> dropped by mode="drop"
 
     # Exact scalar statistics never need the buffer: pure segment reduces.
+    # Sums fold through the 2Sum hi/lo pairs — the per-batch delta is a
+    # dense f32 scatter-add (a batch holds at most `batch` samples per
+    # slot, so the delta itself is near-exact), then the running totals
+    # absorb it with an error-free transformation (scalar.py counters).
+    dsum = jnp.zeros_like(bank.vsum).at[sd].add(w * v, mode="drop")
+    dcount = jnp.zeros_like(bank.count).at[sd].add(w, mode="drop")
+    drecip = jnp.zeros_like(bank.recip).at[sd].add(
+        jnp.where(v != 0, w / jnp.where(v != 0, v, 1.0), 0.0), mode="drop")
+    vsum, vsum_lo = _two_sum(bank.vsum, dsum + bank.vsum_lo)
+    count, count_lo = _two_sum(bank.count, dcount + bank.count_lo)
+    recip, recip_lo = _two_sum(bank.recip, drecip + bank.recip_lo)
     bank = bank._replace(
         vmin=bank.vmin.at[sd].min(jnp.where(valid, v, _INF), mode="drop"),
         vmax=bank.vmax.at[sd].max(jnp.where(valid, v, -_INF), mode="drop"),
-        vsum=bank.vsum.at[sd].add(w * v, mode="drop"),
-        count=bank.count.at[sd].add(w, mode="drop"),
-        recip=bank.recip.at[sd].add(
-            jnp.where(v != 0, w / jnp.where(v != 0, v, 1.0), 0.0),
-            mode="drop"),
+        vsum=vsum, count=count, recip=recip,
+        vsum_lo=vsum_lo, count_lo=count_lo, recip_lo=recip_lo,
     )
 
     def cond(state):
@@ -321,12 +344,20 @@ def merge_scalars(bank: TDigestBank, slots, vmins, vmaxs, vsums, counts,
     K = bank.num_slots
     valid = slots >= 0
     sd = jnp.where(valid, slots, K)
+    dsum = jnp.zeros_like(bank.vsum).at[sd].add(
+        jnp.where(valid, vsums, 0.0), mode="drop")
+    dcount = jnp.zeros_like(bank.count).at[sd].add(
+        jnp.where(valid, counts, 0.0), mode="drop")
+    drecip = jnp.zeros_like(bank.recip).at[sd].add(
+        jnp.where(valid, recips, 0.0), mode="drop")
+    vsum, vsum_lo = _two_sum(bank.vsum, dsum + bank.vsum_lo)
+    count, count_lo = _two_sum(bank.count, dcount + bank.count_lo)
+    recip, recip_lo = _two_sum(bank.recip, drecip + bank.recip_lo)
     return bank._replace(
         vmin=bank.vmin.at[sd].min(jnp.where(valid, vmins, _INF), mode="drop"),
         vmax=bank.vmax.at[sd].max(jnp.where(valid, vmaxs, -_INF), mode="drop"),
-        vsum=bank.vsum.at[sd].add(jnp.where(valid, vsums, 0.0), mode="drop"),
-        count=bank.count.at[sd].add(jnp.where(valid, counts, 0.0), mode="drop"),
-        recip=bank.recip.at[sd].add(jnp.where(valid, recips, 0.0), mode="drop"),
+        vsum=vsum, count=count, recip=recip,
+        vsum_lo=vsum_lo, count_lo=count_lo, recip_lo=recip_lo,
     )
 
 
@@ -417,17 +448,23 @@ def _interp_knots(knot_q, knot_v, qs):
 def aggregates(bank: TDigestBank):
     """The non-percentile flush aggregates of samplers.Histo
     (samplers/samplers.go sym: HistogramAggregates): max, min, sum, avg,
-    count, hmean (median comes from quantile(0.5))."""
-    cnt = bank.count
+    count, hmean (median comes from quantile(0.5)).
+
+    The single fold hi + lo here rounds once (relative error ~2^-24) —
+    fine for on-device consumers; hosts needing exact counts past 2^24
+    read the bank's (hi, lo) pairs directly and sum in float64."""
+    cnt = bank.count + bank.count_lo
+    vsum = bank.vsum + bank.vsum_lo
+    recip = bank.recip + bank.recip_lo
     safe = jnp.where(cnt > 0, cnt, 1.0)
     return {
         "min": jnp.where(cnt > 0, bank.vmin, 0.0),
         "max": jnp.where(cnt > 0, bank.vmax, 0.0),
-        "sum": bank.vsum,
+        "sum": vsum,
         "count": cnt,
-        "avg": jnp.where(cnt > 0, bank.vsum / safe, 0.0),
-        "hmean": jnp.where(bank.recip > 0, cnt / jnp.where(
-            bank.recip > 0, bank.recip, 1.0), 0.0),
+        "avg": jnp.where(cnt > 0, vsum / safe, 0.0),
+        "hmean": jnp.where(recip > 0, cnt / jnp.where(
+            recip > 0, recip, 1.0), 0.0),
     }
 
 
@@ -447,4 +484,7 @@ def reset(bank: TDigestBank) -> TDigestBank:
         vsum=jnp.zeros((k,), dt),
         count=jnp.zeros((k,), dt),
         recip=jnp.zeros((k,), dt),
+        vsum_lo=jnp.zeros((k,), dt),
+        count_lo=jnp.zeros((k,), dt),
+        recip_lo=jnp.zeros((k,), dt),
     )

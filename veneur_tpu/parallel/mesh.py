@@ -286,6 +286,11 @@ class MeshEngine:
                 vsum=jax.lax.psum(hb.vsum, "dp"),
                 count=jax.lax.psum(hb.count, "dp"),
                 recip=jax.lax.psum(hb.recip, "dp"),
+                # compensation terms sum independently: D small terms
+                # cannot reintroduce meaningful rounding error
+                vsum_lo=jax.lax.psum(hb.vsum_lo, "dp"),
+                count_lo=jax.lax.psum(hb.count_lo, "dp"),
+                recip_lo=jax.lax.psum(hb.recip_lo, "dp"),
             )
             merged = tdigest._compress_impl(merged, comp)
 
@@ -302,7 +307,9 @@ class MeshEngine:
             mean=P("shard", None), weight=P("shard", None),
             buf_value=P("shard", None), buf_weight=P("shard", None),
             buf_n=P("shard"), vmin=P("shard"), vmax=P("shard"),
-            vsum=P("shard"), count=P("shard"), recip=P("shard"))
+            vsum=P("shard"), count=P("shard"), recip=P("shard"),
+            vsum_lo=P("shard"), count_lo=P("shard"),
+            recip_lo=P("shard"))
         out_specs = (bank_spec, P("shard"), P("shard"), P("shard"),
                      P("shard", None))
         # check_vma=False: outputs ARE dp-replicated (they come from
