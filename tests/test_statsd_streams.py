@@ -170,3 +170,36 @@ def test_native_mode_tcp_slow_path():
         assert vals["ntcp.count"] == 4.0
     finally:
         srv.stop()
+
+
+def test_oversized_line_suffix_is_discarded():
+    """An oversized stream line is dropped IN FULL: its later bytes
+    (arriving in subsequent reads) must not be parsed as fresh metrics
+    (advisor r1: discard-until-newline)."""
+    srv, cap = make_server(None, "tcp://127.0.0.1:0",
+                           metric_max_length=512)
+    try:
+        port = srv._listen_socks[0].getsockname()[1]
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as c:
+            # chunk 1: > max_len with no newline -> dropped, reader
+            # enters discard mode
+            c.sendall(b"x" * 600)
+            time.sleep(0.05)
+            # chunk 2: still the SAME logical line; pre-fix this parsed
+            # as a fresh metric
+            c.sendall(b"evil.count:1|c\n")
+            time.sleep(0.05)
+            # chunk 3: a real line after the terminator
+            c.sendall(b"good.count:2|c\n")
+        assert wait_packets(srv, 1)
+        vals = flush_values(srv, cap)
+        assert "evil.count" not in vals
+        assert vals["good.count"] == 2.0
+        # the oversized line was counted (flush_values runs flush_once,
+        # which drains the counter into self-metrics — read the flushed
+        # self-metric, not the already-reset live counter)
+        errs = [m.value for fl in cap.flushes for m in fl
+                if m.name == "veneur.packet.error_total"]
+        assert errs and errs[0] >= 1
+    finally:
+        srv.stop()

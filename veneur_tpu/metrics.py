@@ -7,6 +7,7 @@ forwarded aggregates (re-expressed in veneur_tpu.cluster.wire).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from enum import IntEnum
 
@@ -50,7 +51,8 @@ class MetricFrame:
     treat it as read-only.
     """
 
-    __slots__ = ("timestamp", "hostname", "_blocks", "_n", "_list")
+    __slots__ = ("timestamp", "hostname", "_blocks", "_n", "_list",
+                 "_mat_lock")
 
     def __init__(self, timestamp: int, hostname: str = ""):
         self.timestamp = timestamp
@@ -58,6 +60,7 @@ class MetricFrame:
         self._blocks: list = []
         self._n = 0
         self._list: list[InterMetric] | None = None
+        self._mat_lock = threading.Lock()
 
     def add_block(self, names, tags, values, types) -> None:
         import numpy as np
@@ -99,9 +102,49 @@ class MetricFrame:
                             tags=tg, type=types[j], hostname=host)
 
     def to_list(self) -> list[InterMetric]:
+        # several sink threads may materialize concurrently; the lock
+        # makes the (expensive) materialization happen exactly once
         if self._list is None:
-            self._list = [m for m in self]
+            with self._mat_lock:
+                if self._list is None:
+                    self._list = [m for m in self]
         return self._list
+
+    @property
+    def blocks(self):
+        """The raw (names, tags, values[n, m], types) blocks — the
+        frame-native sink serialization surface."""
+        return self._blocks
+
+
+class FrameSet:
+    """One flush's complete output: the engines' columnar frames plus
+    loose InterMetrics (self-telemetry). This is what the server hands
+    to sinks. Frame-native sinks serialize straight from the blocks;
+    legacy sinks iterate, which materializes InterMetric objects lazily
+    in the SINK's thread (off the flush critical path) and caches them
+    once for all such sinks."""
+
+    __slots__ = ("frames", "extra")
+
+    def __init__(self, frames=None, extra=None):
+        self.frames = frames or []
+        self.extra = extra or []
+
+    def __len__(self) -> int:
+        return sum(len(f) for f in self.frames) + len(self.extra)
+
+    def __iter__(self):
+        for f in self.frames:
+            yield from f
+        yield from self.extra
+
+    def to_list(self) -> list[InterMetric]:
+        out = []
+        for f in self.frames:
+            out.extend(f.to_list())
+        out.extend(self.extra)
+        return out
 
 
 @dataclass

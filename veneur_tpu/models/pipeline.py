@@ -25,11 +25,13 @@ Scope routing (flusher.go semantics):
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from dataclasses import dataclass, field as dc_field
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ingest.parser import (
@@ -44,6 +46,120 @@ from .worker import KeyInterner
 # program; wider (untrusted) forwarded digests are pre-clustered in
 # chunks of this size first.
 _IMPORT_W_CAP = 4096
+
+
+# ---------------- compiled flush programs (shared across engines) --------
+#
+# The flush must be ONE XLA dispatch, not a chain (compress -> quantile ->
+# aggregates -> estimate as separate jits measured ~2000x slower than the
+# fused program on a tunneled TPU backend, r2 bench), and its inputs and
+# outputs must be COMMITTED to a concrete device: executables built against
+# uncommitted arrays take a drastically slower path on that backend (see
+# parallel/mesh.py's matching notes). Both factories are lru_cached on the
+# static config so every engine with the same shape shares one executable
+# and one compile.
+
+@functools.lru_cache(maxsize=None)
+def _fresh_banks_executable(device, histogram_slots, compression,
+                            buffer_depth, counter_slots, gauge_slots,
+                            set_slots, hll_precision):
+    """One jitted program materializing a full set of fresh interval banks
+    on `device` — the Worker.Flush map-swap costs one dispatch, not ~15
+    host-built zero arrays."""
+    sds = jax.sharding.SingleDeviceSharding(device)
+
+    def make():
+        return (tdigest.init(histogram_slots, compression, buffer_depth),
+                scalar.init_counters(counter_slots),
+                scalar.init_gauges(gauge_slots),
+                hll.init(set_slots, hll_precision))
+
+    return jax.jit(make, out_shardings=sds)
+
+
+@functools.lru_cache(maxsize=None)
+def _ingest_executables(device, compression):
+    """Committed-output builds of the four ingest scatter kernels.
+
+    The module-level ops (tdigest.add_batch & co) are plain jits: their
+    outputs are UNCOMMITTED, and an executable whose bank inputs are
+    uncommitted is the ~1000x-slow variant on the tunneled TPU backend —
+    which would put every ingest batch AND the following flush on the
+    slow path. Pinning out_shardings keeps the whole bank lineage
+    committed from _fresh_banks onward."""
+    sds = jax.sharding.SingleDeviceSharding(device)
+
+    def add_histos(bank, slots, values, weights):
+        return tdigest._add_batch_impl(bank, slots, values, weights,
+                                       compression)
+
+    jit = functools.partial(jax.jit, donate_argnums=(0,),
+                            out_shardings=sds)
+    return {
+        "histo": jit(add_histos),
+        "counter": jit(scalar.counter_add.__wrapped__),
+        "gauge": jit(scalar.gauge_set.__wrapped__),
+        "set": jit(hll.insert.__wrapped__),
+    }
+
+
+@functools.lru_cache(maxsize=None)
+def _flush_executable(device, compression, fwd_out, agg_emit, pallas_ok):
+    """The fused interval-flush program: compress + quantiles + the
+    configured aggregates + counter/gauge/set finalization in ONE XLA
+    call, returning only the compact arrays the host assembly needs
+    (plus raw sketch state when this engine forwards upstream).
+
+    Output contract (all f32 unless noted):
+      q        [K, P']      quantile matrix (P' includes a median column
+                            when configured)
+      aggcols  [K, A]       one column per configured aggregate, in
+                            `agg_emit` order; `count`/`sum` columns carry
+                            the 2Sum hi term only
+      lo_count/lo_sum [K]   the matching lo terms (only when configured):
+                            exact value = f64(hi) + f64(lo) on host
+      cnt      [K]          folded count for liveness (only when `count`
+                            is NOT a configured aggregate)
+      c_hi/c_lo [Kc], g_value [Kg], g_seq i32[Kg], s_est [Ks]
+      h_* / s_regs          raw forward-export state (fwd_out only)
+    """
+    sds = jax.sharding.SingleDeviceSharding(device)
+
+    def program(hb, cb, gb, sb, qs):
+        hb = tdigest._compress_impl(hb, compression)
+        agg = tdigest.aggregates(hb)
+        out = {
+            "q": tdigest.quantile(hb, qs),
+            "c_hi": cb.hi, "c_lo": cb.lo,
+            "g_value": gb.value, "g_seq": gb.seq,
+            "s_est": hll.estimate(sb, force_jnp=not pallas_ok),
+        }
+        cols = []
+        for a in agg_emit:
+            if a == "count":
+                cols.append(hb.count)
+                out["lo_count"] = hb.count_lo
+            elif a == "sum":
+                cols.append(hb.vsum)
+                out["lo_sum"] = hb.vsum_lo
+            else:
+                cols.append(agg[a])
+        if cols:
+            out["aggcols"] = jnp.stack(cols, axis=1)
+        if "count" not in agg_emit:
+            out["cnt"] = agg["count"]
+        if fwd_out:
+            out.update(
+                h_mean=hb.mean, h_weight=hb.weight,
+                h_min=hb.vmin, h_max=hb.vmax,
+                h_sum=hb.vsum, h_sum_lo=hb.vsum_lo,
+                h_count=hb.count, h_count_lo=hb.count_lo,
+                h_recip=hb.recip, h_recip_lo=hb.recip_lo,
+                s_regs=sb.registers)
+        return out
+
+    return jax.jit(program, donate_argnums=(0, 1, 2, 3),
+                   out_shardings=sds)
 
 
 @dataclass
@@ -137,11 +253,18 @@ class AggregationEngine:
         # immutable snapshot lock-free while ingest continues.
         self.lock = threading.Lock()
         cfg = self.cfg
-        self.histo_bank = tdigest.init(
-            cfg.histogram_slots, cfg.compression, cfg.buffer_depth)
-        self.counter_bank = scalar.init_counters(cfg.counter_slots)
-        self.gauge_bank = scalar.init_gauges(cfg.gauge_slots)
-        self.set_bank = hll.init(cfg.set_slots, cfg.hll_precision)
+        # Banks are committed to one concrete device and every interval's
+        # fresh banks come out of the same committed-output program —
+        # keeping the whole serving path on the fast committed-executable
+        # path (see the factory comments above).
+        self._device = jax.devices()[0]
+        self._fresh_fn = _fresh_banks_executable(
+            self._device, cfg.histogram_slots, cfg.compression,
+            cfg.buffer_depth, cfg.counter_slots, cfg.gauge_slots,
+            cfg.set_slots, cfg.hll_precision)
+        (self.histo_bank, self.counter_bank,
+         self.gauge_bank, self.set_bank) = self._fresh_fn()
+        self._kern = _ingest_executables(self._device, cfg.compression)
 
         self.histo_keys = KeyInterner(cfg.histogram_slots,
                                       cfg.idle_ttl_intervals)
@@ -189,6 +312,12 @@ class AggregationEngine:
         self._histo_full_types = (
             (MetricType.GAUGE,) * len(self._pct_sufs) + agg_types)
         self._histo_agg_types = agg_types
+        self._agg_idx = {a: i for i, a in enumerate(self._agg_emit)}
+        self._fwd_out = cfg.forward_enabled and not cfg.is_global
+        self._flush_exec = _flush_executable(
+            self._device, cfg.compression, self._fwd_out,
+            tuple(self._agg_emit),
+            self._device.platform in ("tpu", "axon"))
         self._tags_cache: dict[str, list] = {}
         self._pres_bound = 4 * (cfg.histogram_slots + cfg.counter_slots
                                 + cfg.gauge_slots + cfg.set_slots)
@@ -272,15 +401,14 @@ class AggregationEngine:
     def ingest_histo_batch(self, slots, values, weights, count=None,
                            mark=None):
         def apply(n):
-            self.histo_bank = tdigest.add_batch(
-                self.histo_bank, slots, values, weights,
-                compression=self.cfg.compression)
+            self.histo_bank = self._kern["histo"](
+                self.histo_bank, slots, values, weights)
         self._ingest_batch(slots, count, mark, apply)
 
     def ingest_counter_batch(self, slots, values, weights, count=None,
                              mark=None):
         def apply(n):
-            self.counter_bank = scalar.counter_add(
+            self.counter_bank = self._kern["counter"](
                 self.counter_bank, slots, values, weights)
         self._ingest_batch(slots, count, mark, apply)
 
@@ -294,13 +422,14 @@ class AggregationEngine:
             seqs = np.arange(1, len(slots) + 1, dtype=np.int32) \
                 + self._gauge_seq
             self._gauge_seq += n
-            self.gauge_bank = scalar.gauge_set(
+            self.gauge_bank = self._kern["gauge"](
                 self.gauge_bank, slots, values, seqs)
         self._ingest_batch(slots, count, mark, apply)
 
     def ingest_set_batch(self, slots, reg_idx, rho, count=None, mark=None):
         def apply(n):
-            self.set_bank = hll.insert(self.set_bank, slots, reg_idx, rho)
+            self.set_bank = self._kern["set"](
+                self.set_bank, slots, reg_idx, rho)
         self._ingest_batch(slots, count, mark, apply)
 
     def process_event(self, ev):
@@ -313,23 +442,22 @@ class AggregationEngine:
 
     def _dispatch_histos(self):
         a = self._histo_stage.drain()
-        self.histo_bank = tdigest.add_batch(
-            self.histo_bank, a["slots"], a["values"], a["weights"],
-            compression=self.cfg.compression)
+        self.histo_bank = self._kern["histo"](
+            self.histo_bank, a["slots"], a["values"], a["weights"])
 
     def _dispatch_counters(self):
         a = self._counter_stage.drain()
-        self.counter_bank = scalar.counter_add(
+        self.counter_bank = self._kern["counter"](
             self.counter_bank, a["slots"], a["values"], a["weights"])
 
     def _dispatch_gauges(self):
         a = self._gauge_stage.drain()
-        self.gauge_bank = scalar.gauge_set(
+        self.gauge_bank = self._kern["gauge"](
             self.gauge_bank, a["slots"], a["values"], a["seqs"])
 
     def _dispatch_sets(self):
         a = self._set_stage.drain()
-        self.set_bank = hll.insert(
+        self.set_bank = self._kern["set"](
             self.set_bank, a["slots"], a["reg_idx"], a["rho"])
 
     def drain_all(self):
@@ -339,6 +467,33 @@ class AggregationEngine:
                        (self._set_stage, self._dispatch_sets)):
             if st.n:
                 fn()
+
+    def warmup(self):
+        """Precompile every device program the serving path dispatches.
+
+        Without this, flush 0 pays the full compile bill inline — ~100s
+        on a cold tunneled-TPU backend (r2 bench), i.e. more than ten
+        flush intervals, which would trip the server's crash-only
+        watchdog before the first flush ever completes. Ingest kernels
+        compile against all-padding batches (slot -1 rows are dropped by
+        the kernels, so live state is untouched); the flush program runs
+        on throwaway fresh banks, which it donates away."""
+        b = self.cfg.batch_size
+        pad = np.full(b, -1, np.int32)
+        zf = np.zeros(b, np.float32)
+        zi = np.zeros(b, np.int32)
+        zu = np.zeros(b, np.uint8)
+        with self.lock:
+            self.histo_bank = self._kern["histo"](
+                self.histo_bank, pad, zf, zf)
+            self.counter_bank = self._kern["counter"](
+                self.counter_bank, pad, zf, zf)
+            self.gauge_bank = self._kern["gauge"](
+                self.gauge_bank, pad, zf, zi)
+            self.set_bank = self._kern["set"](self.set_bank, pad, zi, zu)
+        hb, cb, gb, sb = self._fresh_fn()
+        jax.device_get(self._flush_exec(hb, cb, gb, sb, self._qs))
+        jax.block_until_ready(self.histo_bank.mean)
 
     # ---------------- import (global tier Combine path) ----------------
 
@@ -388,26 +543,28 @@ class AggregationEngine:
         if not self._import_sets:
             return
         items, self._import_sets = self._import_sets, []
-        self.set_bank = hll.merge_rows(
+        self.set_bank = jax.device_put(hll.merge_rows(
             self.set_bank,
             np.array([s for s, _ in items], np.int32),
-            np.stack([r for _, r in items]))
+            np.stack([r for _, r in items])), self._device)
 
     def _flush_import_scalars(self):
         if self._import_counter_acc:
             acc, self._import_counter_acc = self._import_counter_acc, {}
-            self.counter_bank = scalar.counter_merge(
+            self.counter_bank = jax.device_put(scalar.counter_merge(
                 self.counter_bank,
                 np.fromiter(acc.keys(), np.int32, len(acc)),
-                np.fromiter(acc.values(), np.float32, len(acc)))
+                np.fromiter(acc.values(), np.float32, len(acc))),
+                self._device)
         if self._import_gauge_acc:
             acc, self._import_gauge_acc = self._import_gauge_acc, {}
             seqs = np.arange(len(acc), dtype=np.int32) + self._gauge_seq + 1
             self._gauge_seq += len(acc)
-            self.gauge_bank = scalar.gauge_set(
+            self.gauge_bank = jax.device_put(scalar.gauge_set(
                 self.gauge_bank,
                 np.fromiter(acc.keys(), np.int32, len(acc)),
-                np.fromiter(acc.values(), np.float32, len(acc)), seqs)
+                np.fromiter(acc.values(), np.float32, len(acc)), seqs),
+                self._device)
 
     def _flush_import_centroids(self):
         """Merge staged foreign digests in O(1) device calls: group the
@@ -508,6 +665,10 @@ class AggregationEngine:
             np.array([it[5] for it in items], np.float32),
             np.array([it[6] for it in items], np.float32),
             np.array([it[7] for it in items], np.float32))
+        # the merge chain above ran through plain jits whose outputs are
+        # uncommitted; recommit so the ingest kernels and the flush
+        # program stay on their committed (fast) executables
+        self.histo_bank = jax.device_put(self.histo_bank, self._device)
 
     # ---------------- flush ----------------
 
@@ -528,13 +689,12 @@ class AggregationEngine:
             self._flush_import_scalars()
 
             # Snapshot current banks (immutable arrays) and hand ingest
-            # fresh ones — the Worker.Flush swap.
+            # fresh ones — the Worker.Flush swap. Fresh banks are ONE
+            # async dispatch of the committed-output zeros program.
             hb, cb, gb, sb = (self.histo_bank, self.counter_bank,
                               self.gauge_bank, self.set_bank)
-            self.histo_bank = tdigest.reset(hb)
-            self.counter_bank = scalar.reset_counters(cb)
-            self.gauge_bank = scalar.reset_gauges(gb)
-            self.set_bank = hll.reset(sb)
+            (self.histo_bank, self.counter_bank,
+             self.gauge_bank, self.set_bank) = self._fresh_fn()
             self._gauge_seq = 0
             active = {
                 "histo": self.histo_keys.active_items(),
@@ -556,39 +716,49 @@ class AggregationEngine:
 
         t_swap = time.perf_counter()
 
-        # Forwarding is the only consumer of the raw centroid matrices and
-        # HLL registers; when it's off (or this is the global tier), skip
-        # fetching them — at 100k slots they dominate transfer time.
-        fwd_out = cfg.forward_enabled and not cfg.is_global
-        hb = tdigest.compress(hb, compression=cfg.compression)
-        device = {
-            "q": tdigest.quantile(hb, self._qs),
-            "agg": tdigest.aggregates(hb),
-            "c_hi": cb.hi, "c_lo": cb.lo,
-            "g_value": gb.value, "g_seq": gb.seq,
-            "s_est": hll.estimate(sb),
-        }
-        if fwd_out:
-            device.update(
-                h_mean=hb.mean, h_weight=hb.weight,
-                h_min=hb.vmin, h_max=hb.vmax, h_sum=hb.vsum,
-                h_count=hb.count, h_recip=hb.recip,
-                s_regs=sb.registers)
-        host = jax.device_get(device)
+        # ONE fused program dispatch + ONE device_get: on a tunneled TPU
+        # backend the transfer of these compact arrays IS the flush cost
+        # (the program itself is ~3ms at 100k slots); everything else
+        # happens on host over the fetched numpy.
+        fwd_out = self._fwd_out
+        host = jax.device_get(self._flush_exec(hb, cb, gb, sb, self._qs))
         t_device = time.perf_counter()
 
         frame = MetricFrame(ts, cfg.hostname)
         export = ForwardExport()
-        agg = host["agg"]
 
         # ---- histograms: vectorized gathers over the active set ----
         infos = active["histo"]
         if infos:
+            # Aggregate matrix in f64 with the 2Sum lo terms folded back
+            # in — count/sum are exact past 2^24 here, unlike any f32.
+            qmat = np.asarray(host["q"], np.float64)
+            if self._agg_emit:
+                aggmat = np.asarray(host["aggcols"]).astype(np.float64)
+                ci = self._agg_idx.get("count")
+                if ci is not None:
+                    aggmat[:, ci] += np.asarray(host["lo_count"],
+                                                np.float64)
+                si = self._agg_idx.get("sum")
+                if si is not None:
+                    aggmat[:, si] += np.asarray(host["lo_sum"],
+                                                np.float64)
+            else:
+                aggmat = np.zeros((qmat.shape[0], 0), np.float64)
+            ci = self._agg_idx.get("count")
+            live_cnt = (aggmat[:, ci] if ci is not None
+                        else np.asarray(host["cnt"], np.float64))
             n = len(infos)
             slots = np.fromiter((t[1] for t in infos), np.int64, n)
             scopes = np.fromiter((t[2] for t in infos), np.int64, n)
-            live = np.asarray(agg["count"])[slots] > 0
+            live = live_cnt[slots] > 0
             if fwd_out:
+                h_sum = (np.asarray(host["h_sum"], np.float64)
+                         + np.asarray(host["h_sum_lo"], np.float64))
+                h_count = (np.asarray(host["h_count"], np.float64)
+                           + np.asarray(host["h_count_lo"], np.float64))
+                h_recip = (np.asarray(host["h_recip"], np.float64)
+                           + np.asarray(host["h_recip_lo"], np.float64))
                 exp_m = live & (scopes != LOCAL_ONLY)
                 full_m = live & (scopes == LOCAL_ONLY)
                 aggonly_m = exp_m & (scopes != GLOBAL_ONLY)
@@ -600,19 +770,12 @@ class AggregationEngine:
                         key, host["h_mean"][slot][nz], w[nz],
                         float(host["h_min"][slot]),
                         float(host["h_max"][slot]),
-                        float(host["h_sum"][slot]),
-                        float(host["h_count"][slot]),
-                        float(host["h_recip"][slot])))
+                        float(h_sum[slot]),
+                        float(h_count[slot]),
+                        float(h_recip[slot])))
             else:
                 full_m = live
                 aggonly_m = None
-            qmat = np.asarray(host["q"], np.float64)
-            if self._agg_emit:
-                aggmat = np.stack(
-                    [np.asarray(agg[a], np.float64)
-                     for a in self._agg_emit], axis=1)
-            else:
-                aggmat = np.zeros((qmat.shape[0], 0), np.float64)
 
             idx = np.nonzero(full_m)[0].tolist()
             if idx:

@@ -30,9 +30,9 @@ import time
 
 from .config import Config
 from .ingest import parser
-from .metrics import InterMetric, MetricType
+from .metrics import FrameSet, InterMetric, MetricType
 from .models.pipeline import AggregationEngine, EngineConfig, ForwardExport
-from .sinks import MetricSink, filter_for_sink
+from .sinks import MetricSink
 from .sinks.basic import (BlackholeMetricSink, DebugMetricSink,
                           LocalFilePlugin)
 
@@ -242,6 +242,19 @@ class Server:
     # ------------- lifecycle -------------
 
     def start(self):
+        # Precompile the device programs BEFORE any listener or the
+        # watchdog exists: a cold backend pays the whole compile bill
+        # here (~tens of seconds on a tunneled TPU), not inside flush 0
+        # where it would overrun watchdog_missed_flushes intervals.
+        # Engines with identical shapes share executables, so this
+        # compiles once and executes cheaply n_workers times.
+        t0 = time.monotonic()
+        for eng in self.engines:
+            eng.warmup()
+        warm_s = time.monotonic() - t0
+        if warm_s > 1.0:
+            log.info("engine warmup (device program compile): %.1fs",
+                     warm_s)
         for s in self.sinks:
             try:
                 s.start()
@@ -272,6 +285,8 @@ class Server:
             self._start_http_api(self.cfg.http_address)
         if self.native_pump is not None:
             self.native_pump.start()
+        # watchdog epoch starts after warmup — compile time is not lag
+        self._last_flush_ok = time.monotonic()
         t = threading.Thread(target=self._flush_loop, name="flusher",
                              daemon=True)
         t.start()
@@ -444,9 +459,13 @@ class Server:
 
     def _read_statsd_stream(self, conn: socket.socket):
         """Newline-delimited metric lines over a stream connection; a
-        line split across reads is reassembled."""
+        line split across reads is reassembled. An oversized line is
+        dropped IN FULL: after the drop the reader stays in discard
+        mode until the line's terminating newline arrives, so the
+        line's later bytes can never be parsed as a fresh metric."""
         max_len = self.cfg.metric_max_length
         tail = b""
+        discarding = False
         try:
             with conn:
                 while not self._stop.is_set():
@@ -455,21 +474,36 @@ class Server:
                     except OSError:
                         return
                     if not data:
-                        if tail:
+                        if tail and not discarding:
                             self.handle_packet(tail)
                         return
+                    if discarding:
+                        nl = data.find(b"\n")
+                        if nl < 0:
+                            continue
+                        data = data[nl + 1:]
+                        discarding = False
+                        if not data:
+                            continue
                     buf = tail + data
                     nl = buf.rfind(b"\n")
                     if nl < 0:
                         tail = buf
                         if len(tail) > max_len:
-                            # oversized garbage line: drop, count
+                            # oversized garbage line: drop, count, and
+                            # swallow the rest of it
                             with self._stats_lock:
                                 self.parse_errors += 1
                             tail = b""
+                            discarding = True
                         continue
                     self.handle_packet(buf[:nl])
                     tail = buf[nl + 1:]
+                    if len(tail) > max_len:
+                        with self._stats_lock:
+                            self.parse_errors += 1
+                        tail = b""
+                        discarding = True
         finally:
             with self._conns_lock:
                 self._stream_conns.discard(conn)
@@ -740,15 +774,18 @@ class Server:
 
     def flush_once(self, timestamp: int | None = None):
         """One flush tick: drain engines, fan out, forward
-        (Server.Flush)."""
+        (Server.Flush). Returns the flush's FrameSet — iterable of
+        InterMetrics; frame-native consumers read .frames directly and
+        InterMetric objects are only ever built lazily, inside whichever
+        sink thread first needs them."""
         t0 = time.monotonic()
         ts = int(timestamp if timestamp is not None else time.time())
-        all_metrics: list[InterMetric] = []
+        frames = []
         merged_export = ForwardExport()
         events, checks = [], []
         for eng in self.engines:
             res = eng.flush(timestamp=ts)
-            all_metrics.extend(res.metrics)
+            frames.append(res.frame)
             merged_export.histograms.extend(res.export.histograms)
             merged_export.sets.extend(res.export.sets)
             merged_export.counters.extend(res.export.counters)
@@ -757,8 +794,8 @@ class Server:
             events.extend(ev)
             checks.extend(ch)
 
-        all_metrics.extend(self._self_metrics(ts, t0))
-        self._fan_out(all_metrics, events, checks)
+        frameset = FrameSet(frames, self._self_metrics(ts, t0))
+        self._fan_out(frameset, events, checks)
 
         if self.forwarder is not None and (
                 merged_export.histograms or merged_export.sets
@@ -768,7 +805,7 @@ class Server:
             except Exception:
                 log.exception("forward failed")
         self.flush_count += 1
-        return all_metrics
+        return frameset
 
     def _self_metrics(self, ts: int, t0: float) -> list[InterMetric]:
         """veneur.* self-telemetry (the internal statsd client's names)."""
@@ -803,14 +840,16 @@ class Server:
             mk("veneur.flush.total_duration_ns", dur_ns, MetricType.GAUGE),
         ]
 
-    def _fan_out(self, metrics, events, checks):
+    def _fan_out(self, frameset, events, checks):
         """Per-sink parallel flush with timeout isolation (one goroutine
-        per sink in Server.Flush)."""
+        per sink in Server.Flush). Sinks receive the columnar FrameSet;
+        legacy sinks materialize InterMetrics lazily in their own thread
+        (cached once, shared), frame-native sinks never do."""
         threads = []
         for s in self.sinks:
             def run(sink=s):
                 try:
-                    sink.flush(filter_for_sink(sink.name(), metrics))
+                    sink.flush_frames(frameset)
                     if events or checks:
                         sink.flush_other(events, checks)
                 except Exception:
@@ -822,7 +861,7 @@ class Server:
         for p in self.plugins:
             def runp(plugin=p):
                 try:
-                    plugin.flush(metrics, self.hostname)
+                    plugin.flush_frames(frameset, self.hostname)
                 except Exception:
                     log.exception("plugin %s flush failed", plugin.name())
             t = threading.Thread(target=runp, daemon=True,

@@ -12,7 +12,7 @@ from __future__ import annotations
 import abc
 from typing import Iterable
 
-from ..metrics import InterMetric
+from ..metrics import FrameSet, InterMetric
 
 
 class MetricSink(abc.ABC):
@@ -27,6 +27,14 @@ class MetricSink(abc.ABC):
     @abc.abstractmethod
     def flush(self, metrics: list[InterMetric]) -> None:
         """Deliver one interval's metrics. Called once per flush tick."""
+
+    def flush_frames(self, frames: FrameSet) -> None:
+        """Frame-aware delivery: the server hands every sink the flush's
+        columnar FrameSet. The default materializes InterMetrics (lazily,
+        in this sink's thread, shared across legacy sinks) and calls
+        flush(); frame-native sinks override this to serialize straight
+        from the blocks and never build 600k Python objects."""
+        self.flush(filter_for_sink(self.name(), frames.to_list()))
 
     def flush_other(self, events, checks) -> None:
         """Deliver events / service checks (FlushOtherSamples)."""
@@ -59,6 +67,10 @@ class Plugin(abc.ABC):
 
     @abc.abstractmethod
     def flush(self, metrics: list[InterMetric], hostname: str) -> None: ...
+
+    def flush_frames(self, frames: FrameSet, hostname: str) -> None:
+        """Frame-aware variant; default materializes lazily."""
+        self.flush(frames.to_list(), hostname)
 
 
 def filter_for_sink(sink_name: str, metrics: Iterable[InterMetric]):

@@ -41,6 +41,7 @@ class DatadogMetricSink(MetricSink):
         self.interval_s = interval_s
         self.flush_max_per_body = flush_max_per_body
         self.timeout_s = timeout_s
+        self._tag_memo: dict = {}
 
     def name(self) -> str:
         return "datadog"
@@ -50,21 +51,12 @@ class DatadogMetricSink(MetricSink):
             mtype, value = "rate", m.value / max(self.interval_s, 1)
         else:
             mtype, value = "gauge", m.value
-        host = m.hostname or self.hostname
-        device = ""
-        tags = list(self.tags)
-        for t in m.tags:
-            if t.startswith("host:"):
-                host = t[5:]
-            elif t.startswith("device:"):
-                device = t[7:]
-            else:
-                tags.append(t)
+        host, device, tags = self._split_tags(m.tags)
         s = {
             "metric": m.name,
             "points": [[m.timestamp, value]],
             "type": mtype,
-            "host": host,
+            "host": host or m.hostname or self.hostname,
             "tags": tags,
             "interval": self.interval_s,
         }
@@ -87,9 +79,74 @@ class DatadogMetricSink(MetricSink):
 
     def flush(self, metrics):
         series = [self._series(m) for m in metrics]
+        self._post_series(series)
+
+    def _post_series(self, series):
         for i in range(0, len(series), self.flush_max_per_body):
             self._post("/api/v1/series",
                        {"series": series[i:i + self.flush_max_per_body]})
+
+    def _split_tags(self, tg: list) -> tuple:
+        """(host_override, device, merged_tags) for one key's shared tag
+        list. Memoized by identity: tag lists are interned per key in the
+        engine's presentation cache and shared across flushes, so the
+        host:/device: scan runs once per key, not once per metric. The
+        memo holds a reference to the list, keeping the id stable."""
+        memo = self._tag_memo.get(id(tg))
+        if memo is not None and memo[0] is tg:
+            return memo[1]
+        host, device, tags = "", "", list(self.tags)
+        for t in tg:
+            if t.startswith("host:"):
+                host = t[5:]
+            elif t.startswith("device:"):
+                device = t[7:]
+            else:
+                tags.append(t)
+        if len(self._tag_memo) > 1_000_000:
+            self._tag_memo.clear()
+        out = (host, device, tags)
+        self._tag_memo[id(tg)] = (tg, out)
+        return out
+
+    def flush_frames(self, frames):
+        """Frame-native flush: build the series bodies straight from the
+        columnar blocks (same wire output as flush(), without
+        materializing InterMetric objects)."""
+        iv = self.interval_s
+        div = max(iv, 1)  # divide (not multiply-by-reciprocal) so the
+        # rate values match _series() bit-for-bit
+        series = []
+        app = series.append
+        for fr in frames.frames:
+            ts = fr.timestamp
+            fr_host = fr.hostname or self.hostname
+            for names, tags, values, types in fr.blocks:
+                is_rate = [t == MetricType.COUNTER for t in types]
+                m = values.shape[1]
+                rows = values.tolist()
+                for nm, tg, row in zip(names, tags, rows):
+                    host, device, dtags = self._split_tags(tg)
+                    h = host or fr_host
+                    cols = (nm,) if m == 1 and isinstance(nm, str) else nm
+                    for j in range(m):
+                        s = {
+                            "metric": cols[j],
+                            "points": [[ts, row[j] / div
+                                        if is_rate[j] else row[j]]],
+                            "type": "rate" if is_rate[j] else "gauge",
+                            "host": h,
+                            "tags": dtags,
+                            "interval": iv,
+                        }
+                        if device:
+                            s["device_name"] = device
+                        app(s)
+        name = self.name()
+        for x in frames.extra:
+            if not x.sinks or name in x.sinks:
+                app(self._series(x))
+        self._post_series(series)
 
     def flush_other(self, events, checks):
         for e in events:

@@ -19,7 +19,7 @@ import logging
 import time
 
 from . import Plugin
-from .basic import tsv_line
+from .basic import tsv_from_frames, tsv_line
 
 log = logging.getLogger("veneur_tpu.sinks.s3")
 
@@ -75,20 +75,29 @@ class S3Plugin(Plugin):
     def name(self) -> str:
         return "s3"
 
-    def flush(self, metrics, hostname):
-        if not metrics:
+    def _upload(self, lines, n: int, hostname: str):
+        """Gzip `lines` (TSV rows) and PutObject; shared by the legacy
+        and frame-native flush paths."""
+        if not n:
             return
         if self.uploader is None:
-            self.dropped_total += len(metrics)
+            self.dropped_total += n
             return
         buf = io.BytesIO()
         with gzip.GzipFile(fileobj=buf, mode="wb") as gz:
-            for m in metrics:
-                gz.write(tsv_line(m, hostname, self.interval_s).encode())
+            for line in lines:
+                gz.write(line.encode())
         try:
             self.uploader(self.bucket, object_key(hostname), buf.getvalue())
-            self.uploaded_total += len(metrics)
+            self.uploaded_total += n
         except Exception as e:
-            self.dropped_total += len(metrics)
-            log.error("s3 upload failed (%d metrics dropped): %s",
-                      len(metrics), e)
+            self.dropped_total += n
+            log.error("s3 upload failed (%d metrics dropped): %s", n, e)
+
+    def flush(self, metrics, hostname):
+        self._upload((tsv_line(m, hostname, self.interval_s)
+                      for m in metrics), len(metrics), hostname)
+
+    def flush_frames(self, frames, hostname):
+        self._upload(tsv_from_frames(frames, hostname, self.interval_s),
+                     len(frames), hostname)
