@@ -451,6 +451,11 @@ struct Bridge {
   // bumped on every advance_interval (evictions may reassign slots);
   // thread-local key caches check it and self-invalidate
   std::atomic<uint64_t> intern_epoch{0};
+  // process-unique identity: thread_local LocalStages outlive any one
+  // Bridge, so their memos must be scoped to the bridge they were
+  // filled from — and a raw pointer is not enough (a new Bridge can be
+  // allocated at a freed one's address with a matching epoch)
+  uint64_t instance_id = 0;
 
   std::mutex newkeys_mu;
   std::deque<NewKey> newkeys;
@@ -475,10 +480,12 @@ struct LocalStage {
   std::vector<std::pair<const uint8_t*, size_t>> secs, tags;
   ParsedMetric m;
   std::string keybuf;
-  // key -> slot memo, valid within one intern epoch: steady-state hot
-  // keys skip the sharded map (and its mutex) entirely
+  // key -> slot memo, valid within one (bridge, intern epoch):
+  // steady-state hot keys skip the sharded map (and its mutex)
+  // entirely; a thread that served a different bridge self-invalidates
   std::unordered_map<std::string, int32_t> key_cache[NUM_BANKS];
   uint64_t cache_epoch = ~0ull;
+  uint64_t cache_owner = 0;  // Bridge::instance_id the memo belongs to
   std::vector<int32_t> slots[NUM_BANKS];
   std::vector<float> a[NUM_BANKS];
   std::vector<float> b[NUM_BANKS];
@@ -591,9 +598,10 @@ void handle_line(Bridge* br, LocalStage* st, const uint8_t* line,
   }
   const ParsedMetric& m = st->m;
   uint64_t ep = br->intern_epoch.load(std::memory_order_acquire);
-  if (st->cache_epoch != ep) {
+  if (st->cache_epoch != ep || st->cache_owner != br->instance_id) {
     for (auto& c : st->key_cache) c.clear();
     st->cache_epoch = ep;
+    st->cache_owner = br->instance_id;
   }
   int cbk = bank_of(m.mtype);
   build_key(m, &st->keybuf);
@@ -695,6 +703,8 @@ void* vtpu_create(int32_t histo_slots, int32_t counter_slots,
                   int32_t hll_precision, int32_t idle_ttl,
                   int32_t ring_capacity, int32_t max_packet) {
   Bridge* br = new Bridge();
+  static std::atomic<uint64_t> next_instance{1};
+  br->instance_id = next_instance.fetch_add(1, std::memory_order_relaxed);
   int32_t caps[NUM_BANKS] = {histo_slots, counter_slots, gauge_slots,
                              set_slots};
   for (int i = 0; i < NUM_BANKS; i++) {

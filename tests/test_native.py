@@ -279,3 +279,37 @@ class TestNativeServer:
         for k in py_out:
             assert native_out[k] == pytest.approx(py_out[k]), k
         assert len(native_ev) == len(py_ev)
+
+
+class TestAdvisorRegressions:
+    def test_thread_local_cache_is_bridge_scoped(self):
+        """A thread that ingested into bridge A must not reuse A's
+        key->slot memo when it later serves bridge B: pre-fix, the
+        thread_local cache was validated only against intern_epoch, so
+        two same-epoch bridges silently misrouted or swallowed keys."""
+        def mk():
+            return native.NativeBridge(
+                histo_slots=64, counter_slots=64, gauge_slots=64,
+                set_slots=64, hll_precision=14, idle_ttl=4,
+                ring_capacity=4096, max_packet=8192)
+
+        a = mk()
+        b = mk()
+        try:
+            # warm this thread's memo on A: k1..k3 -> slots 0..2
+            a.handle_packet(b"k1:1|c\nk2:1|c\nk3:1|c")
+            a_keys = {k[4]: k[3] for k in a.drain_new_keys()}
+            assert a_keys["k3"] == 2
+            # B interns one unrelated key (slot 0), then sees k3 — which
+            # the stale memo would resolve to A's slot 2 without ever
+            # interning it in B
+            b.handle_packet(b"other:1|c")
+            b.handle_packet(b"k3:5|c")
+            b_keys = {k[4]: k[3] for k in b.drain_new_keys()}
+            assert "k3" in b_keys, "k3 swallowed by a foreign bridge memo"
+            assert b_keys["k3"] == 1
+            got, slots, vals, _, _ = poll_all(b, "counter")
+            assert 5.0 in vals[slots == b_keys["k3"]].tolist()
+        finally:
+            a.close()
+            b.close()

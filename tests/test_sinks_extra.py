@@ -239,6 +239,19 @@ class TestPrometheus:
         finally:
             sink.stop()
 
+    def test_counter_totals_bounded_under_series_churn(self):
+        """Unbounded metric-name churn must not grow the cumulative
+        counter dict forever (advisor r1: TTL-expire _counter_totals);
+        a continuously-flushed series keeps accumulating."""
+        sink = PrometheusMetricSink("127.0.0.1:0",
+                                    counter_idle_flushes=5)
+        for i in range(200):
+            sink.flush([im(f"churn.{i}", 1, MetricType.COUNTER),
+                        im("steady", 2, MetricType.COUNTER)])
+        # 5-flush TTL: at most the steady key + the last 5-6 churn keys
+        assert len(sink._counter_totals) <= 8
+        assert b'steady{hostname="h"} 400' in sink._body
+
 
 # ---------------- s3 plugin ----------------
 

@@ -69,7 +69,11 @@ def load() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        path = build()
+        # Env override: point the whole process at an alternate build of
+        # the bridge — how CI runs the native tests under TSAN
+        # (`make -C native tsan`, then VENEUR_TPU_NATIVE_LIB=
+        # native/build/libvtpu_ingest_tsan.so with libtsan LD_PRELOADed).
+        path = os.environ.get("VENEUR_TPU_NATIVE_LIB") or build()
         lib = ctypes.CDLL(path)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)

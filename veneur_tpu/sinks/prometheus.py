@@ -75,8 +75,38 @@ def render(metrics: list[InterMetric],
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+class _TtlCounterTotals(dict):
+    """Cumulative counter state with idle eviction: a series not flushed
+    for `idle_flushes` intervals is dropped, so unbounded metric-name
+    churn cannot grow the dict forever. `render` touches every live key
+    via `__setitem__`, which doubles as the liveness signal; an evicted
+    series that reappears restarts from its next delta (a counter reset,
+    which Prometheus clients already handle via staleness/rate())."""
+
+    def __init__(self, idle_flushes: int = 60):
+        super().__init__()
+        self.idle_flushes = idle_flushes
+        self._last_seen: dict = {}
+        self._flush_idx = 0
+
+    def __setitem__(self, key, value):
+        self._last_seen[key] = self._flush_idx
+        super().__setitem__(key, value)
+
+    def advance(self):
+        self._flush_idx += 1
+        horizon = self._flush_idx - self.idle_flushes
+        if horizon <= 0:
+            return
+        dead = [k for k, t in self._last_seen.items() if t < horizon]
+        for k in dead:
+            del self._last_seen[k]
+            self.pop(k, None)
+
+
 class PrometheusMetricSink(MetricSink):
-    def __init__(self, listen_address: str = "127.0.0.1:9125"):
+    def __init__(self, listen_address: str = "127.0.0.1:9125",
+                 counter_idle_flushes: int = 60):
         # parsed in start() so a malformed address disables this sink
         # (the server catches start() errors per-sink) instead of
         # aborting server construction
@@ -85,7 +115,7 @@ class PrometheusMetricSink(MetricSink):
         self.port = -1
         self._body = b""
         self._lock = threading.Lock()
-        self._counter_totals: dict = {}
+        self._counter_totals = _TtlCounterTotals(counter_idle_flushes)
         self._server: ThreadingHTTPServer | None = None
 
     def name(self) -> str:
@@ -123,6 +153,7 @@ class PrometheusMetricSink(MetricSink):
     def flush(self, metrics):
         with self._lock:
             self._body = render(metrics, self._counter_totals).encode()
+            self._counter_totals.advance()
 
     def stop(self):
         if self._server is not None:
