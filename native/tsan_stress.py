@@ -1,0 +1,108 @@
+"""TSAN stress harness for the native ingest bridge.
+
+The concurrency test story for the C++ bridge (SURVEY §5 — the rebuild's
+analogue of the reference's `go test -race`): exercise every cross-thread
+path at once — SO_REUSEPORT UDP readers, the Python caller's
+thread_local staging (two bridges to cover the bridge-scoped memo),
+concurrent ring drains (the pump path), new-key/slow-path drains, and
+interval advancement with eviction — under ThreadSanitizer.
+
+Run (from repo root; deliberately does NOT import jax/pytest — TSAN
+makes them unusably slow):
+
+    make -C native tsan
+    LD_PRELOAD=$(g++ -print-file-name=libtsan.so) \
+    VENEUR_TPU_NATIVE_LIB=native/build/libvtpu_ingest_tsan.so \
+    TSAN_OPTIONS=exitcode=66 python native/tsan_stress.py
+
+Exit 0 + "tsan stress ok" and no "WARNING: ThreadSanitizer" output means
+a clean run; TSAN itself exits 66 on a detected race.
+"""
+
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from veneur_tpu.ingest import native  # noqa: E402
+
+DURATION_S = float(os.environ.get("TSAN_STRESS_S", "5"))
+
+
+def main() -> int:
+    bridges = [native.NativeBridge(
+        histo_slots=256, counter_slots=256, gauge_slots=128,
+        set_slots=64, hll_precision=10, idle_ttl=2,
+        ring_capacity=65536, max_packet=8192) for _ in range(2)]
+    port = bridges[0].start_udp("127.0.0.1", 0, n_readers=2)
+
+    stop = threading.Event()
+
+    def sender():
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        i = 0
+        while not stop.is_set():
+            s.sendto(
+                (f"t{i % 97}:{i % 31}|ms|#env:prod\n"
+                 f"c{i % 53}:1|c|@0.5\nu:{i % 1009}|s").encode(),
+                ("127.0.0.1", port))
+            i += 1
+
+    def direct_caller():
+        # alternates bridges from ONE thread: the bridge-scoped
+        # thread_local memo must never cross-pollinate
+        i = 0
+        while not stop.is_set():
+            bridges[i % 2].handle_packet(
+                f"d{i % 41}:{i % 7}|ms\ng:{i}|g".encode())
+            i += 1
+
+    import numpy as np
+
+    def pump(br):
+        slots = np.zeros(4096, np.int32)
+        a = np.zeros(4096, np.float32)
+        b = np.zeros(4096, np.float32)
+        c = np.zeros(4096, np.int32)
+        polled = 0
+        while not stop.is_set():
+            for bank in ("histo", "counter", "gauge", "set"):
+                polled += max(0, br.poll(bank, slots, a, b, c))
+            br.drain_new_keys()
+            br.drain_other()
+            time.sleep(0.001)
+        return polled
+
+    def ticker(br):
+        while not stop.is_set():
+            for bank in ("histo", "counter", "gauge", "set"):
+                br.advance_interval(bank)
+            br.slot_scopes("histo")
+            br.stats()
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=f, daemon=True) for f in (
+        sender, sender, direct_caller,
+        lambda: pump(bridges[0]), lambda: pump(bridges[1]),
+        lambda: ticker(bridges[0]), lambda: ticker(bridges[1]))]
+    for t in threads:
+        t.start()
+    time.sleep(DURATION_S)
+    stop.set()
+    for t in threads:
+        t.join(5)
+    stats = bridges[0].stats()
+    for br in bridges:
+        br.close()
+    assert stats["packets"] > 0 and stats["lines"] > 0, stats
+    print(f"tsan stress ok: {stats['lines']} lines through "
+          f"{len(threads)} threads")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -189,3 +189,101 @@ def test_proxy_grpc_end_to_end():
         assert len(_CaptureForwarder.instances) == 2
     finally:
         proxy.stop()
+
+
+def test_discovering_forwarder_rotates_and_refreshes():
+    """consul_forward_service_name path: destinations come from a
+    Discoverer, rotate round-robin, and re-resolve after the refresh
+    interval (discovery.go / Server.RefreshDestinations)."""
+    from veneur_tpu.cluster.discovery import StaticDiscoverer
+    from veneur_tpu.cluster.forward import DiscoveringForwarder
+
+    calls = []
+
+    class FakeFwd:
+        def __init__(self, dest):
+            self.dest = dest
+
+        def __call__(self, export):
+            calls.append(self.dest)
+
+    disc = StaticDiscoverer(["a:1", "b:2"])
+    fwd = DiscoveringForwarder(disc, "veneur-global",
+                               refresh_interval_s=0.0,
+                               forwarder_factory=FakeFwd)
+    for _ in range(4):
+        fwd(None)
+    assert calls == ["a:1", "b:2", "a:1", "b:2"]
+    disc.destinations = ["c:3"]
+    fwd(None)
+    assert calls[-1] == "c:3"
+
+    class Flaky:
+        def get_destinations_for_service(self, service):
+            raise OSError("consul down")
+
+    fwd2 = DiscoveringForwarder(Flaky(), "svc", refresh_interval_s=0.0,
+                                forwarder_factory=FakeFwd)
+    fwd2(None)  # must not raise
+    assert fwd2.errors >= 1
+
+
+def test_http_proxy_front_distributes_consistently():
+    """POST /import batches are split per metric and consistent-hashed
+    across destinations on the SAME ring as the gRPC arm (proxy.go sym:
+    Proxy.Handler / Proxy.ProxyMetrics)."""
+    import json as _json
+    import urllib.request
+
+    from veneur_tpu.cluster.discovery import StaticDiscoverer
+    from veneur_tpu.cluster.proxy import HttpProxyFront, ProxyServer
+
+    received: dict[str, list] = {"a": [], "b": [], "c": []}
+
+    class FakeDest:
+        def __init__(self, dest):
+            self.dest = dest
+
+        def send_json(self, dicts):
+            received[self.dest].extend(dicts)
+
+    proxy = ProxyServer(StaticDiscoverer(["a", "b", "c"]),
+                        refresh_interval_s=3600)
+    front = HttpProxyFront(proxy, dest_factory=FakeDest)
+    srv, port = front.start("127.0.0.1:0")
+    try:
+        batch = [{"name": f"m{i}", "type": "counter",
+                  "tags": ["env:prod"], "value": i} for i in range(300)]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/import",
+            data=_json.dumps(batch).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+        total = sum(len(v) for v in received.values())
+        assert total == 300
+        # all three destinations get a share, and the split is stable
+        assert all(len(v) > 30 for v in received.values())
+        first = {d: [m["name"] for m in v] for d, v in received.items()}
+        for v in received.values():
+            v.clear()
+        with urllib.request.urlopen(req, timeout=5):
+            pass
+        assert {d: [m["name"] for m in v]
+                for d, v in received.items()} == first
+        # same metric routes to the same place as the gRPC arm's ring
+        from veneur_tpu.cluster.proxy import ConsistentRing
+        assert isinstance(proxy.ring, ConsistentRing)
+        # malformed body -> 400, nothing crashes
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/import", data=b"{not json",
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(bad, timeout=5)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        assert front.proxied_total == 600
+    finally:
+        front.stop()
+        proxy.stop()

@@ -170,13 +170,32 @@ def test_percentile_names_and_median():
     assert m["t.count"].value == 1000.0
 
 
-def test_events_and_checks_drain():
+def test_events_drain_and_status_checks_aggregate():
+    """Events pass through; service checks are a SAMPLER: last status
+    per (name, tags) per interval, flushed as status-typed InterMetrics
+    (samplers.go sym: StatusCheck)."""
+    from veneur_tpu.metrics import MetricType
+
     eng = AggregationEngine(small_config())
     eng.process_event(parser.parse_packet(b"_e{2,2}:ab|cd"))
     eng.process_service_check(parser.parse_packet(b"_sc|svc|0"))
+    eng.process_service_check(
+        parser.parse_packet(b"_sc|svc|2|m:down hard"))   # last wins
+    eng.process_service_check(
+        parser.parse_packet(b"_sc|svc|1|#env:qa"))       # distinct key
     evs, chks = eng.drain_events()
-    assert len(evs) == 1 and len(chks) == 1
-    assert eng.drain_events() == ([], [])
+    assert len(evs) == 1 and chks == []
+    res = eng.flush(timestamp=50)
+    status = sorted((m for m in res.metrics
+                     if m.type == MetricType.STATUS),
+                    key=lambda m: (m.name, tuple(m.tags)))
+    assert len(status) == 2
+    assert status[0].tags == [] and status[0].value == 2.0
+    assert status[0].message == "down hard"
+    assert status[1].tags == ["env:qa"] and status[1].value == 1.0
+    # interval-scoped: second flush has no status metrics
+    assert not [m for m in eng.flush(timestamp=51).metrics
+                if m.type == MetricType.STATUS]
 
 
 def test_slot_eviction_and_reuse():
@@ -231,3 +250,42 @@ def test_single_column_histo_block_names_are_strings():
     out = eng.flush(timestamp=1).metrics
     assert [m.name for m in out] == ["t.req.count"]
     assert out[0].value == pytest.approx(2.0)
+
+
+def test_hot_slot_batch_accuracy_and_count():
+    """A batch that overfills one slot's buffer many times over takes the
+    host pre-cluster sidestep (one compress instead of ~n/B full-bank
+    sorts) and must stay exact on count/sum and within 1% on quantiles
+    (VERDICT r2 weak #5)."""
+    import numpy as np
+
+    from veneur_tpu.ingest.parser import MetricKey
+
+    eng = AggregationEngine(EngineConfig(
+        histogram_slots=64, counter_slots=8, gauge_slots=8, set_slots=8,
+        buffer_depth=64, percentiles=(0.5, 0.99),
+        aggregates=("min", "max", "count", "sum")))
+    hot = eng.histo_keys.lookup(MetricKey("hot", "timer", ""), 0)
+    cold = eng.histo_keys.lookup(MetricKey("cold", "timer", ""), 0)
+    rng = np.random.default_rng(7)
+    hv = rng.gamma(2.0, 20.0, 8192).astype(np.float32)
+    slots = np.full(8192, hot, np.int32)
+    slots[::16] = cold  # interleave a cold slot through the same batch
+    cv = hv[::16]
+    eng.ingest_histo_batch(slots, hv, np.ones(8192, np.float32))
+    by = {m.name: m.value for m in eng.flush(timestamp=1).metrics}
+
+    hot_vals = hv[slots == hot]
+    assert by["hot.count"] == float(len(hot_vals))
+    assert abs(by["hot.sum"] - hot_vals.sum(dtype=np.float64)) \
+        / hot_vals.sum(dtype=np.float64) < 1e-6
+    assert by["hot.min"] == float(hot_vals.min())
+    assert by["hot.max"] == float(hot_vals.max())
+    for q in (0.5, 0.99):
+        exp = float(np.quantile(hot_vals.astype(np.float64), q))
+        got = by[f"hot.{q*100:g}percentile"]
+        assert abs(got - exp) / exp < 0.01, (q, got, exp)
+    assert by["cold.count"] == float(len(cv))
+    for q in (0.5,):
+        exp = float(np.quantile(cv.astype(np.float64), q))
+        assert abs(by[f"cold.{q*100:g}percentile"] - exp) / exp < 0.02

@@ -1,0 +1,105 @@
+"""Multi-chip serving path: a running Server backed by a mesh-sharded
+engine (tpu_num_devices > 1) on the virtual 8-device CPU mesh.
+
+This is VERDICT r3 item 4 / SURVEY §7 step 7: UDP datagrams in → slot
+routing over the ("dp", "shard") mesh → SPMD scatter ingest → collective
+flush merge → correct global percentiles out of the server — the
+in-process "multi-node" test strategy of the reference (two-server
+loopback tests in server_test.go), mapped onto XLA host devices.
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.ingest.parser import MetricKey
+from veneur_tpu.models.pipeline import EngineConfig
+from veneur_tpu.parallel.engine import MeshAggregationEngine
+from veneur_tpu.server import Server
+from veneur_tpu.sinks.basic import CaptureMetricSink
+
+
+def test_mesh_engine_unit_all_types():
+    """Direct engine test across every bank type and many slots, so
+    samples land on every shard column."""
+    eng = MeshAggregationEngine(EngineConfig(
+        histogram_slots=64, counter_slots=32, gauge_slots=32,
+        set_slots=16, buffer_depth=32, batch_size=256,
+        percentiles=(0.5, 0.9), aggregates=("min", "max", "count")),
+        n_devices=8)
+    eng.warmup()
+    rng = np.random.default_rng(3)
+    from veneur_tpu.ingest import parser
+    vals = {}
+    lines = []
+    for k in range(16):  # 16 keys spread across 8 shards
+        v = rng.gamma(2.0, 20.0, 40)
+        vals[f"t{k}"] = v
+        lines += [f"t{k}:{x:.4f}|ms".encode() for x in np.round(v, 4)]
+    lines += [b"c:2|c|@0.5"] * 5 + [b"g:1|g", b"g:9|g"]
+    lines += [f"s:m{i % 23}|s".encode() for i in range(200)]
+    for ln in lines:
+        eng.process(parser.parse_packet(ln))
+    by = {m.name: m.value for m in eng.flush(timestamp=7).metrics}
+    for k, v in vals.items():
+        v = np.round(v, 4)
+        assert by[f"{k}.count"] == 40.0
+        assert by[f"{k}.min"] == float(np.float32(v.min()))
+        assert by[f"{k}.max"] == float(np.float32(v.max()))
+        exp = np.quantile(v, 0.5)
+        assert abs(by[f"{k}.50percentile"] - exp) / exp < 0.02
+    assert by["c"] == 20.0
+    assert by["g"] == 9.0
+    assert abs(by["s"] - 23) / 23 < 0.15
+    # second flush is empty (interval semantics survive the mesh swap)
+    assert len(eng.flush(timestamp=8).metrics) == 0
+
+
+def test_mesh_server_end_to_end_udp():
+    cap = CaptureMetricSink()
+    cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                 interval="3600s", hostname="mesh-host",
+                 tpu_num_devices=8,
+                 tpu_histogram_slots=64, tpu_counter_slots=32,
+                 tpu_gauge_slots=32, tpu_set_slots=16,
+                 tpu_buffer_depth=32, tpu_batch_size=256,
+                 percentiles=[0.5, 0.99], aggregates=["count"])
+    srv = Server(cfg, sinks=[cap], plugins=[], span_sinks=[])
+    assert type(srv.engines[0]).__name__ == "MeshAggregationEngine"
+    srv.start()
+    try:
+        port = srv.bound_port()
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rng = np.random.default_rng(11)
+        v = np.round(rng.gamma(2.0, 20.0, 600), 3)
+        for i, x in enumerate(v):
+            s.sendto(f"pod.ms:{x:.3f}|ms".encode(), ("127.0.0.1", port))
+        s.sendto(b"pod.hits:5|c", ("127.0.0.1", port))
+        deadline = time.monotonic() + 10
+        while (srv.packets_received < len(v) + 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert srv.drain(10)
+        srv.flush_once(timestamp=99)
+        assert cap.wait_for_flush()
+        by = {m.name: m for m in cap.all_metrics}
+        assert by["pod.ms.count"].value == float(len(v))
+        for q in (0.5, 0.99):
+            exp = float(np.quantile(v, q))
+            got = by[f"pod.ms.{q*100:g}percentile"].value
+            assert abs(got - exp) / exp < 0.02, (q, got, exp)
+        assert by["pod.hits"].value == 5.0
+        assert by["pod.ms.count"].timestamp == 99
+    finally:
+        srv.stop()
+
+
+def test_mesh_engine_rejects_forward_and_global():
+    with pytest.raises(ValueError):
+        MeshAggregationEngine(EngineConfig(forward_enabled=True),
+                              n_devices=8)
+    with pytest.raises(ValueError):
+        MeshAggregationEngine(EngineConfig(is_global=True), n_devices=8)

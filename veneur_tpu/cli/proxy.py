@@ -42,6 +42,12 @@ def proxy_from_config(cfg: dict):
                         refresh_interval_s=refresh)
     addr = cfg.get("grpc_address", "0.0.0.0:8128")
     proxy.start(addr)
+    # legacy HTTP face (proxy.go sym: Proxy.Handler): POST /import
+    http_addr = cfg.get("http_address", "")
+    if http_addr:
+        from ..cluster.proxy import HttpProxyFront
+        proxy.http_front = HttpProxyFront(proxy)
+        proxy.http_front.start(http_addr)
     logging.getLogger("veneur-proxy").info(
         "proxying on %s -> %d destinations", addr, len(proxy.ring))
     return proxy

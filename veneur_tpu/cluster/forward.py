@@ -93,3 +93,60 @@ class HttpJsonForwarder:
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             if resp.status >= 400:
                 raise RuntimeError(f"forward POST: HTTP {resp.status}")
+
+
+class DiscoveringForwarder:
+    """Forward via a Consul-discovered destination
+    (consul_forward_service_name + consul_refresh_interval in config.go;
+    Server.RefreshDestinations). Destinations are re-resolved lazily
+    once per refresh interval; flushes rotate through the healthy set so
+    a fleet of locals spreads load across the global tier."""
+
+    def __init__(self, discoverer, service: str,
+                 refresh_interval_s: float = 30.0, use_grpc: bool = True,
+                 forwarder_factory=None):
+        self.discoverer = discoverer
+        self.service = service
+        self.refresh_interval_s = refresh_interval_s
+        if forwarder_factory is None:
+            forwarder_factory = (GrpcForwarder if use_grpc
+                                 else HttpJsonForwarder)
+        self.factory = forwarder_factory
+        self._dests: list[str] = []
+        self._fwds: dict = {}
+        self._next_refresh = 0.0
+        self._rr = 0
+        self.errors = 0
+
+    def _refresh(self):
+        import time as _t
+        if _t.monotonic() < self._next_refresh and self._dests:
+            return
+        try:
+            dests = self.discoverer.get_destinations_for_service(
+                self.service)
+        except Exception as e:
+            self.errors += 1
+            log.warning("discovery refresh failed for %s: %s",
+                        self.service, e)
+            return
+        self._next_refresh = _t.monotonic() + self.refresh_interval_s
+        if dests and sorted(dests) != sorted(self._dests):
+            log.info("forward destinations for %s: %s", self.service,
+                     dests)
+            self._dests = dests
+            self._fwds = {d: f for d, f in self._fwds.items()
+                          if d in dests}
+
+    def __call__(self, export):
+        self._refresh()
+        if not self._dests:
+            self.errors += 1
+            log.warning("no forward destinations for %s", self.service)
+            return
+        dest = self._dests[self._rr % len(self._dests)]
+        self._rr += 1
+        fwd = self._fwds.get(dest)
+        if fwd is None:
+            fwd = self._fwds[dest] = self.factory(dest)
+        fwd(export)

@@ -74,6 +74,7 @@ class ProxyServer:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._grpc_server = None
+        self.http_front = None   # attached by the CLI when configured
         self.refresh_destinations()
 
     # ---- ring maintenance ----
@@ -183,3 +184,127 @@ class ProxyServer:
         self._stop.set()
         if self._grpc_server is not None:
             self._grpc_server.stop(1.0)
+        if self.http_front is not None:
+            self.http_front.stop()
+
+
+class _JsonDest:
+    """POST a JSONMetric batch to one destination's /import
+    (the HTTP fan-out arm of proxy.go sym: Proxy.ProxyMetrics)."""
+
+    def __init__(self, dest: str, timeout_s: float = 10.0):
+        base = dest if "://" in dest else f"http://{dest}"
+        self.url = base.rstrip("/") + "/import"
+        self.timeout_s = timeout_s
+
+    def send_json(self, dicts: list):
+        import json as _json
+        import urllib.request
+        req = urllib.request.Request(
+            self.url, data=_json.dumps(dicts).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            if resp.status >= 400:
+                raise RuntimeError(f"proxy POST: HTTP {resp.status}")
+
+
+class HttpProxyFront:
+    """The legacy HTTP face of veneur-proxy (proxy.go sym: Proxy.Handler):
+    POST /import bodies are split per metric, consistent-hashed on the
+    SAME ring as the gRPC arm (identical key string, so a mixed fleet
+    routes identically), re-batched and POSTed concurrently to each
+    destination's /import."""
+
+    def __init__(self, proxy: ProxyServer, dest_factory=_JsonDest):
+        self.proxy = proxy
+        self._dests: dict[str, _JsonDest] = {}
+        self._factory = dest_factory
+        self._server = None
+        self.proxied_total = 0
+        self.errors_total = 0
+
+    def route_json(self, dicts: list) -> dict[str, list]:
+        groups: dict[str, list] = {}
+        ring = self.proxy.ring
+        with self.proxy._lock:
+            for d in dicts:
+                joined = ",".join(sorted(d.get("tags", [])))
+                ring_key = (f"{d.get('name', '')}{d.get('type', '')}"
+                            f"{joined}").encode()
+                groups.setdefault(ring.get(ring_key), []).append(d)
+        return groups
+
+    def handle_batch(self, dicts: list) -> list:
+        groups = self.route_json(dicts)
+        errs: list = []
+        failed = [0]
+        threads = []
+        for dest, ms in groups.items():
+            def send(dest=dest, ms=ms):
+                try:
+                    fw = self._dests.get(dest)
+                    if fw is None:
+                        fw = self._dests[dest] = self._factory(dest)
+                    fw.send_json(ms)
+                except Exception as e:
+                    log.warning("http proxy forward to %s failed: %s",
+                                dest, e)
+                    errs.append(e)
+                    failed[0] += len(ms)
+            t = threading.Thread(target=send, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        self.proxied_total += len(dicts) - failed[0]
+        self.errors_total += len(errs)
+        return errs
+
+    def start(self, address: str):
+        import json as _json
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") in ("/healthcheck", ""):
+                    self.send_response(200)
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/import":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    dicts = _json.loads(self.rfile.read(n))
+                    assert isinstance(dicts, list)
+                except Exception:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                errs = front.handle_batch(dicts)
+                self.send_response(502 if errs else 200)
+                self.end_headers()
+
+        host, _, port = address.rpartition(":")
+        self._server = ThreadingHTTPServer(
+            (host.strip("[]") or "0.0.0.0", int(port)), Handler)
+        threading.Thread(target=self._server.serve_forever,
+                         name="proxy-http", daemon=True).start()
+        return self._server, self._server.server_address[1]
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()

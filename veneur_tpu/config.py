@@ -57,8 +57,9 @@ class Config:
     http_address: str = ""
     debug: bool = False
     enable_profiling: bool = False
-    mutex_profile_fraction: int = 0
-    block_profile_rate: int = 0
+    profile_port: int = 9943           # JAX profiler (xprof) server port
+    mutex_profile_fraction: int = 0    # accepted for YAML compat;
+    block_profile_rate: int = 0        # Go-runtime-only, warned at start
     sentry_dsn: str = ""
     stats_address: str = ""
 
@@ -84,6 +85,8 @@ class Config:
     datadog_api_key: str = ""
     datadog_api_hostname: str = "https://app.datadoghq.com"
     datadog_flush_max_per_body: int = 25000
+    datadog_trace_api_address: str = ""   # local APM agent, e.g.
+    #                                       http://127.0.0.1:8126
     signalfx_api_key: str = ""
     signalfx_endpoint_base: str = "https://ingest.signalfx.com"
     signalfx_vary_key_by: str = ""

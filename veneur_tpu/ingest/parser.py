@@ -91,8 +91,14 @@ class ServiceCheck:
     tags: list[str] = field(default_factory=list)
 
 
-def parse_metric(packet: bytes) -> UDPMetric:
-    """Parse one DogStatsD metric line (no trailing newline)."""
+def parse_metric(packet: bytes,
+                 exclude_tags: frozenset | None = None) -> UDPMetric:
+    """Parse one DogStatsD metric line (no trailing newline).
+
+    `exclude_tags` (config.go sym: Config.TagsExclude) drops tags whose
+    NAME (the part before ":", or the whole tag) matches — before key
+    construction, so metrics differing only in an excluded tag aggregate
+    together, exactly like the reference."""
     if not packet:
         raise ParseError("empty packet")
 
@@ -160,6 +166,9 @@ def parse_metric(packet: bytes) -> UDPMetric:
                     scope = GLOBAL_ONLY
                 elif ts:
                     tags.append(ts)
+            if exclude_tags:
+                tags = [t for t in tags
+                        if t.partition(":")[0] not in exclude_tags]
             tags.sort()
         else:
             raise ParseError(f"unknown section {section!r} in {packet!r}")
@@ -267,11 +276,11 @@ def parse_service_check(packet: bytes) -> ServiceCheck:
     return sc
 
 
-def parse_packet(packet: bytes):
+def parse_packet(packet: bytes, exclude_tags: frozenset | None = None):
     """Dispatch one datagram line to the right parser, like
     Server.HandleMetricPacket (server.go)."""
     if packet.startswith(b"_e{"):
         return parse_event(packet)
     if packet.startswith(b"_sc|"):
         return parse_service_check(packet)
-    return parse_metric(packet)
+    return parse_metric(packet, exclude_tags)

@@ -93,6 +93,9 @@ def _ingest_executables(device, compression):
         return tdigest._add_batch_impl(bank, slots, values, weights,
                                        compression)
 
+    def compress(bank):
+        return tdigest._compress_impl(bank, compression)
+
     jit = functools.partial(jax.jit, donate_argnums=(0,),
                             out_shardings=sds)
     return {
@@ -100,6 +103,10 @@ def _ingest_executables(device, compression):
         "counter": jit(scalar.counter_add.__wrapped__),
         "gauge": jit(scalar.gauge_set.__wrapped__),
         "set": jit(hll.insert.__wrapped__),
+        # hot-slot sidestep programs (see _add_histo_batch)
+        "compress": jit(compress),
+        "merge_centroids": jit(tdigest.merge_centroids.__wrapped__),
+        "merge_scalars": jit(tdigest.merge_scalars.__wrapped__),
     }
 
 
@@ -198,18 +205,22 @@ class FlushResult:
     assembles (cheap); `metrics` materializes the InterMetric list from it
     lazily, so callers that re-serialize anyway can consume the frame."""
 
-    __slots__ = ("frame", "export", "stats", "_metrics")
+    __slots__ = ("frame", "export", "stats", "_metrics",
+                 "status_metrics")
 
-    def __init__(self, frame=None, export=None, stats=None, metrics=None):
+    def __init__(self, frame=None, export=None, stats=None, metrics=None,
+                 status_metrics=None):
         self.frame = frame
         self.export = export if export is not None else ForwardExport()
         self.stats = stats if stats is not None else {}
         self._metrics = metrics
+        self.status_metrics = status_metrics or []
 
     @property
     def metrics(self) -> list:
         if self._metrics is None:
-            self._metrics = self.frame.to_list() if self.frame else []
+            self._metrics = ((self.frame.to_list() if self.frame else [])
+                             + self.status_metrics)
         return self._metrics
 
 
@@ -244,6 +255,28 @@ class _Stage:
 
 
 class AggregationEngine:
+    def _setup_device(self):
+        """Build the device-side state: committed banks plus the shared
+        fresh-banks and ingest executables (see the factory comments
+        above). Overridden by the mesh engine, which owns sharded banks
+        over a Mesh instead of single-device ones."""
+        cfg = self.cfg
+        self._device = jax.devices()[0]
+        self._fresh_fn = _fresh_banks_executable(
+            self._device, cfg.histogram_slots, cfg.compression,
+            cfg.buffer_depth, cfg.counter_slots, cfg.gauge_slots,
+            cfg.set_slots, cfg.hll_precision)
+        (self.histo_bank, self.counter_bank,
+         self.gauge_bank, self.set_bank) = self._fresh_fn()
+        self._kern = _ingest_executables(self._device, cfg.compression)
+
+    def _setup_flush_exec(self):
+        cfg = self.cfg
+        self._flush_exec = _flush_executable(
+            self._device, cfg.compression, self._fwd_out,
+            tuple(self._agg_emit),
+            self._device.platform in ("tpu", "axon"))
+
     def __init__(self, config: EngineConfig | None = None):
         self.cfg = config or EngineConfig()
         # One ingest thread owns process(); flush() may run from another
@@ -253,18 +286,7 @@ class AggregationEngine:
         # immutable snapshot lock-free while ingest continues.
         self.lock = threading.Lock()
         cfg = self.cfg
-        # Banks are committed to one concrete device and every interval's
-        # fresh banks come out of the same committed-output program —
-        # keeping the whole serving path on the fast committed-executable
-        # path (see the factory comments above).
-        self._device = jax.devices()[0]
-        self._fresh_fn = _fresh_banks_executable(
-            self._device, cfg.histogram_slots, cfg.compression,
-            cfg.buffer_depth, cfg.counter_slots, cfg.gauge_slots,
-            cfg.set_slots, cfg.hll_precision)
-        (self.histo_bank, self.counter_bank,
-         self.gauge_bank, self.set_bank) = self._fresh_fn()
-        self._kern = _ingest_executables(self._device, cfg.compression)
+        self._setup_device()
 
         self.histo_keys = KeyInterner(cfg.histogram_slots,
                                       cfg.idle_ttl_intervals)
@@ -314,10 +336,7 @@ class AggregationEngine:
         self._histo_agg_types = agg_types
         self._agg_idx = {a: i for i, a in enumerate(self._agg_emit)}
         self._fwd_out = cfg.forward_enabled and not cfg.is_global
-        self._flush_exec = _flush_executable(
-            self._device, cfg.compression, self._fwd_out,
-            tuple(self._agg_emit),
-            self._device.platform in ("tpu", "axon"))
+        self._setup_flush_exec()
         self._tags_cache: dict[str, list] = {}
         self._pres_bound = 4 * (cfg.histogram_slots + cfg.counter_slots
                                 + cfg.gauge_slots + cfg.set_slots)
@@ -330,7 +349,10 @@ class AggregationEngine:
         self._import_counter_acc: dict = {}   # slot -> host f64 sum
         self._import_gauge_acc: dict = {}     # slot -> last value
         self._pending_events: list = []
-        self._pending_checks: list = []
+        # StatusCheck sampler state (samplers.go sym: StatusCheck): last
+        # status/message per (name, tags) per interval, flushed as
+        # status-typed InterMetrics — NOT passed through raw.
+        self._status: dict = {}
 
     # ---------------- ingest ----------------
 
@@ -401,9 +423,91 @@ class AggregationEngine:
     def ingest_histo_batch(self, slots, values, weights, count=None,
                            mark=None):
         def apply(n):
+            self._add_histos(slots, values, weights)
+        self._ingest_batch(slots, count, mark, apply)
+
+    def _add_histos(self, slots, values, weights):
+        """Land one histogram batch, sidestepping the hot-slot worst
+        case: add_batch's while-loop pays a full-bank [K, C+B] sort per
+        buffer-depth's worth of samples landing on ONE slot, so a batch
+        where max-per-slot is 8192/B=32x over depth costs 32 sorts. When
+        a batch overfills any slot, pre-cluster the hot slots' samples
+        on host to <= B weighted points each (numpy sort + bucketed
+        segment means — the same two-level scheme the digest itself
+        uses, so accuracy is unchanged within the k1 clustering's own
+        granularity), then land everything with ONE compress +
+        merge_centroids + exact merge_scalars."""
+        slots = np.asarray(slots)
+        B = self.histo_bank.buf_size
+        valid = slots >= 0
+        uniq, cnt = np.unique(slots[valid], return_counts=True)
+        if cnt.size == 0 or cnt.max() <= B:
             self.histo_bank = self._kern["histo"](
                 self.histo_bank, slots, values, weights)
-        self._ingest_batch(slots, count, mark, apply)
+            return
+        values = np.asarray(values)
+        weights = np.asarray(weights)
+        hot = set(uniq[cnt > B].tolist())
+        hot_m = np.isin(slots, list(hot)) & valid
+        cold_slots = np.where(hot_m, -1, slots).astype(np.int32)
+        self.histo_bank = self._kern["histo"](
+            self.histo_bank, cold_slots, values, weights)
+
+        out_s, out_m, out_w = [], [], []
+        sc_s, sc_min, sc_max, sc_sum, sc_cnt, sc_rcp = \
+            [], [], [], [], [], []
+        for s in hot:
+            m = (slots == s) & valid
+            v = values[m].astype(np.float64)
+            w = weights[m].astype(np.float64)
+            order = np.argsort(v, kind="stable")
+            v, w = v[order], w[order]
+            # k1-spaced bucket edges (dense at both tails, like the
+            # digest's own scale function) — uniform count buckets
+            # flatten the tail and cost several % at p99
+            qi = (np.sin(np.pi * np.arange(B + 1) / B
+                         - np.pi / 2) + 1.0) / 2.0
+            edges = np.unique(
+                np.floor(qi * len(v)).astype(np.int64))
+            edges = edges[edges < len(v)]
+            wsum = np.add.reduceat(w, edges)
+            vsum = np.add.reduceat(v * w, edges)
+            keep = wsum > 0
+            out_s.append(np.full(keep.sum(), s, np.int32))
+            out_m.append((vsum[keep] / wsum[keep]).astype(np.float32))
+            out_w.append(wsum[keep].astype(np.float32))
+            sc_s.append(s)
+            sc_min.append(v[0])
+            sc_max.append(v[-1])
+            sc_sum.append(float((v * w).sum()))
+            sc_cnt.append(float(w.sum()))
+            nz = v != 0
+            sc_rcp.append(float((w[nz] / v[nz]).sum()))
+
+        flat_s = np.concatenate(out_s)
+        flat_m = np.concatenate(out_m)
+        flat_w = np.concatenate(out_w)
+        # ONE fixed shape per engine (worst case: every sample in the
+        # batch belongs to a hot slot) — a varying width would JIT a new
+        # executable inline, under the ingest lock, per width
+        width, swidth = self._hot_widths()
+        pad_s = np.full(width, -1, np.int32)
+        pad_m = np.zeros(width, np.float32)
+        pad_w = np.zeros(width, np.float32)
+        pad_s[:len(flat_s)] = flat_s
+        pad_m[:len(flat_s)] = flat_m
+        pad_w[:len(flat_s)] = flat_w
+        nh = len(sc_s)
+        spad = np.full(swidth, -1, np.int32)
+        spad[:nh] = np.asarray(sc_s, np.int32)
+        f = lambda a: np.pad(np.asarray(a, np.float32), (0, swidth - nh))
+        # compress first so merge_centroids has a full buffer of headroom
+        self.histo_bank = self._kern["compress"](self.histo_bank)
+        self.histo_bank = self._kern["merge_centroids"](
+            self.histo_bank, pad_s, pad_m, pad_w)
+        self.histo_bank = self._kern["merge_scalars"](
+            self.histo_bank, spad, f(sc_min), f(sc_max), f(sc_sum),
+            f(sc_cnt), f(sc_rcp))
 
     def ingest_counter_batch(self, slots, values, weights, count=None,
                              mark=None):
@@ -437,13 +541,15 @@ class AggregationEngine:
             self._pending_events.append(ev)
 
     def process_service_check(self, sc):
+        """Aggregate one service check: last write wins per
+        (name, tags) within the interval (samplers.go sym:
+        StatusCheck.Sample — a gauge over status codes)."""
         with self.lock:
-            self._pending_checks.append(sc)
+            self._status[(sc.name, tuple(sc.tags))] = sc
 
     def _dispatch_histos(self):
         a = self._histo_stage.drain()
-        self.histo_bank = self._kern["histo"](
-            self.histo_bank, a["slots"], a["values"], a["weights"])
+        self._add_histos(a["slots"], a["values"], a["weights"])
 
     def _dispatch_counters(self):
         a = self._counter_stage.drain()
@@ -468,6 +574,14 @@ class AggregationEngine:
             if st.n:
                 fn()
 
+    def _hot_widths(self):
+        """Fixed pad shapes for the hot-slot sidestep: at most
+        batch/B slots can be hot in one batch, each contributing <= B
+        pre-clustered points."""
+        B = self.cfg.buffer_depth
+        n_hot = max(1, self.cfg.batch_size // max(1, B))
+        return n_hot * min(B, self.cfg.batch_size), max(1, n_hot)
+
     def warmup(self):
         """Precompile every device program the serving path dispatches.
 
@@ -491,6 +605,16 @@ class AggregationEngine:
             self.gauge_bank = self._kern["gauge"](
                 self.gauge_bank, pad, zf, zi)
             self.set_bank = self._kern["set"](self.set_bank, pad, zi, zu)
+            # hot-slot sidestep programs, at their (fixed) shapes
+            width, swidth = self._hot_widths()
+            self.histo_bank = self._kern["compress"](self.histo_bank)
+            self.histo_bank = self._kern["merge_centroids"](
+                self.histo_bank, np.full(width, -1, np.int32),
+                np.zeros(width, np.float32), np.zeros(width, np.float32))
+            sz = np.zeros(swidth, np.float32)
+            self.histo_bank = self._kern["merge_scalars"](
+                self.histo_bank, np.full(swidth, -1, np.int32),
+                sz, sz, sz, sz, sz)
         hb, cb, gb, sb = self._fresh_fn()
         jax.device_get(self._flush_exec(hb, cb, gb, sb, self._qs))
         jax.block_until_ready(self.histo_bank.mean)
@@ -672,6 +796,25 @@ class AggregationEngine:
 
     # ---------------- flush ----------------
 
+    def _swap_banks(self):
+        """Under the lock: return the interval's bank snapshot and hand
+        ingest fresh banks — the Worker.Flush swap, ONE async dispatch
+        of the committed-output zeros program. Overridden by the mesh
+        engine (its reset donates the sharded banks)."""
+        snap = (self.histo_bank, self.counter_bank,
+                self.gauge_bank, self.set_bank)
+        (self.histo_bank, self.counter_bank,
+         self.gauge_bank, self.set_bank) = self._fresh_fn()
+        return snap
+
+    def _flush_device(self, snap) -> dict:
+        """Run the fused flush program on the snapshot and fetch the
+        compact host arrays: ONE program dispatch + ONE device_get (on a
+        tunneled TPU backend the transfer IS the flush cost; the program
+        itself is ~3ms at 100k slots). Overridden by the mesh engine."""
+        hb, cb, gb, sb = snap
+        return jax.device_get(self._flush_exec(hb, cb, gb, sb, self._qs))
+
     def flush(self, timestamp: int | None = None) -> FlushResult:
         """The Server.Flush equivalent: snapshot banks, run the merge
         program, assemble InterMetrics + forward exports, reset state.
@@ -687,14 +830,7 @@ class AggregationEngine:
             self._flush_import_centroids()
             self._flush_import_sets()
             self._flush_import_scalars()
-
-            # Snapshot current banks (immutable arrays) and hand ingest
-            # fresh ones — the Worker.Flush swap. Fresh banks are ONE
-            # async dispatch of the committed-output zeros program.
-            hb, cb, gb, sb = (self.histo_bank, self.counter_bank,
-                              self.gauge_bank, self.set_bank)
-            (self.histo_bank, self.counter_bank,
-             self.gauge_bank, self.set_bank) = self._fresh_fn()
+            snap = self._swap_banks()
             self._gauge_seq = 0
             active = {
                 "histo": self.histo_keys.active_items(),
@@ -702,6 +838,7 @@ class AggregationEngine:
                 "gauge": self.gauge_keys.active_items(),
                 "set": self.set_keys.active_items(),
             }
+            status, self._status = self._status, {}
             stats_samples = self.samples_processed
             self.samples_processed = 0
             dropped = 0
@@ -715,13 +852,8 @@ class AggregationEngine:
                 ki.advance_interval()
 
         t_swap = time.perf_counter()
-
-        # ONE fused program dispatch + ONE device_get: on a tunneled TPU
-        # backend the transfer of these compact arrays IS the flush cost
-        # (the program itself is ~3ms at 100k slots); everything else
-        # happens on host over the fetched numpy.
         fwd_out = self._fwd_out
-        host = jax.device_get(self._flush_exec(hb, cb, gb, sb, self._qs))
+        host = self._flush_device(snap)
         t_device = time.perf_counter()
 
         frame = MetricFrame(ts, cfg.hostname)
@@ -856,6 +988,18 @@ class AggregationEngine:
                     [self._scalar_tags_of(infos[i]) for i in keep],
                     ests[keep], (MetricType.GAUGE,))
 
+        # ---- status checks (StatusCheck sampler flush shape) ----
+        status_metrics = [
+            InterMetric(
+                name=sc.name,
+                timestamp=int(sc.timestamp or ts),
+                value=float(sc.status),
+                tags=list(sc.tags),
+                type=MetricType.STATUS,
+                message=sc.message,
+                hostname=sc.hostname or cfg.hostname)
+            for sc in status.values()]
+
         t_end = time.perf_counter()
         stats = {
             "samples": stats_samples,
@@ -867,7 +1011,8 @@ class AggregationEngine:
             "merge_ns": int((t_device - t_swap) * 1e9),
             "assembly_ns": int((t_end - t_device) * 1e9),
         }
-        return FlushResult(frame=frame, export=export, stats=stats)
+        return FlushResult(frame=frame, export=export, stats=stats,
+                           status_metrics=status_metrics)
 
     # ---- presentation caches (names/tags reused across flushes) ----
     # Cached on the interner's per-key SlotInfo holder: a plain attribute
@@ -906,5 +1051,4 @@ class AggregationEngine:
     def drain_events(self):
         with self.lock:
             evs, self._pending_events = self._pending_events, []
-            chks, self._pending_checks = self._pending_checks, []
-        return evs, chks
+        return evs, []

@@ -1,0 +1,233 @@
+"""MeshAggregationEngine: the serving engine over a multi-chip Mesh.
+
+This is the `tpu_num_devices > 1` serving path (SURVEY §7 step 7): one
+engine whose banks are sharded over a ("dp", "shard") mesh, fed by the
+same staging/interning machinery as the single-device engine. The host
+keeps GLOBAL slot ids (slot g lives on shard g // slots_per_shard);
+each staged batch is routed into the [D, S*N] segment layout in one
+vectorized pass and landed by the MeshEngine's SPMD scatter program;
+flush is the MeshEngine's collective merge (all_gather + psum/pmax over
+ICI) followed by the shared host assembly.
+
+Parity: this subsumes the reference's in-process worker sharding
+(`Workers[Digest % len(Workers)]`, server.go) — the hash space is
+partitioned over chips instead of goroutines — while the cluster tier
+(forwardrpc over DCN) stays above it, unchanged.
+
+Limitations (explicit, enforced at construction):
+  * no upstream forwarding from a mesh engine (a multi-chip pod IS the
+    global tier for its keys; cross-pod aggregation goes through the
+    cluster tier's importsrv against a single-device global engine);
+  * no Combine/import into a mesh engine yet, for the same reason.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..models.pipeline import AggregationEngine, EngineConfig
+from .mesh import MeshEngine, make_mesh
+
+
+class MeshAggregationEngine(AggregationEngine):
+    def __init__(self, config: EngineConfig, n_devices: int | None = None,
+                 mesh=None, n_dp: int = 1):
+        if config.forward_enabled:
+            raise ValueError(
+                "mesh engine cannot forward upstream; point local "
+                "veneurs at this server's import listener instead")
+        if config.is_global:
+            raise ValueError("mesh engine does not accept imports yet; "
+                             "use a single-device global engine")
+        self._mesh_cfg = (mesh, n_devices, n_dp)
+        self._pad_cache: dict = {}
+        super().__init__(config)
+
+    # ---------------- device setup ----------------
+
+    def _setup_device(self):
+        cfg = self.cfg
+        mesh, n_devices, n_dp = self._mesh_cfg
+        if mesh is None:
+            devs = jax.devices()
+            if n_devices is not None:
+                devs = devs[:n_devices]
+            mesh = make_mesh(n_dp, len(devs) // n_dp, devices=devs)
+        self._device = mesh.devices.reshape(-1)[0]
+
+        def pad_to(total, s):
+            return -(-total // s) * s
+
+        self.me = MeshEngine(
+            mesh,
+            histogram_slots=pad_to(cfg.histogram_slots, mesh.shape["shard"]),
+            counter_slots=pad_to(cfg.counter_slots, mesh.shape["shard"]),
+            gauge_slots=pad_to(cfg.gauge_slots, mesh.shape["shard"]),
+            set_slots=pad_to(cfg.set_slots, mesh.shape["shard"]),
+            compression=cfg.compression,
+            buf_size=cfg.buffer_depth,
+            hll_precision=cfg.hll_precision,
+            percentiles=tuple(cfg.percentiles))
+        self.S = self.me.S
+
+    def _setup_flush_exec(self):
+        # the MeshEngine owns the compiled flush; the single-device
+        # _flush_executable is never built for a mesh engine
+        self._flush_exec = None
+
+    # ---------------- ingest ----------------
+    # Staged batches carry GLOBAL slot ids straight from the interners;
+    # each dispatch routes one bank's batch into the segment layout and
+    # runs the SPMD scatter with all-padding batches for the other
+    # banks (fixed shapes, so there is exactly one ingest executable).
+
+    def _route(self, per_shard, slots, *arrays, fill=0.0):
+        out = self.me.route_batch(
+            slots, *arrays, slots_per_shard=per_shard,
+            n_per_segment=len(np.asarray(slots)), fill=fill)
+        assert out[-1] == 0  # segments are batch-sized: cannot overflow
+        return out[:-1]
+
+    def _pad(self, dtype=np.float32, fill=0.0):
+        # all-padding batches are constant; build each once and share
+        # (JAX never mutates jit inputs, and neither do we)
+        key = (np.dtype(dtype).name, fill)
+        cached = self._pad_cache.get(key)
+        if cached is None:
+            shape = (self.me.D, self.S * self.cfg.batch_size)
+            cached = np.full(shape, fill, dtype)
+            cached.setflags(write=False)
+            self._pad_cache[key] = cached
+        return cached
+
+    def _pads_for(self, *banks):
+        out = []
+        for b in banks:
+            if b == "histo" or b == "counter":
+                out += [self._pad(np.int32, -1), self._pad(), self._pad()]
+            elif b == "gauge":
+                out += [self._pad(np.int32, -1), self._pad(),
+                        self._pad(np.int32)]
+            else:
+                out += [self._pad(np.int32, -1), self._pad(np.int32),
+                        self._pad(np.uint8)]
+        return out
+
+    def _add_histos(self, slots, values, weights):
+        hs, hv, hw = self._route(
+            self.me.histogram_slots // self.S, slots, values, weights)
+        self.me.ingest(hs, hv, hw, *self._pads_for("counter", "gauge",
+                                                   "set"))
+
+    def _dispatch_histos(self):
+        a = self._histo_stage.drain()
+        self._add_histos(a["slots"], a["values"], a["weights"])
+
+    def _dispatch_counters(self):
+        a = self._counter_stage.drain()
+        cs, cv, cw = self._route(
+            self.me.counter_slots // self.S, a["slots"], a["values"],
+            a["weights"])
+        self.me.ingest(*self._pads_for("histo"), cs, cv, cw,
+                       *self._pads_for("gauge", "set"))
+
+    def _dispatch_gauges(self):
+        a = self._gauge_stage.drain()
+        gs, gv, gq = self._route(
+            self.me.gauge_slots // self.S, a["slots"], a["values"],
+            a["seqs"])
+        self.me.ingest(*self._pads_for("histo", "counter"), gs, gv, gq,
+                       *self._pads_for("set"))
+
+    def _dispatch_sets(self):
+        a = self._set_stage.drain()
+        ss, si, sr = self._route(
+            self.me.set_slots // self.S, a["slots"], a["reg_idx"],
+            a["rho"])
+        self.me.ingest(*self._pads_for("histo", "counter", "gauge"),
+                       ss, si, sr)
+
+    def ingest_histo_batch(self, slots, values, weights, count=None,
+                           mark=None):
+        def apply(n):
+            self._add_histos(slots, values, weights)
+        self._ingest_batch(slots, count, mark, apply)
+
+    def ingest_counter_batch(self, slots, values, weights, count=None,
+                             mark=None):
+        def apply(n):
+            cs, cv, cw = self._route(
+                self.me.counter_slots // self.S, slots, values, weights)
+            self.me.ingest(*self._pads_for("histo"), cs, cv, cw,
+                           *self._pads_for("gauge", "set"))
+        self._ingest_batch(slots, count, mark, apply)
+
+    def ingest_gauge_batch(self, slots, values, count=None, mark=None):
+        def apply(n):
+            seqs = np.arange(1, len(slots) + 1, dtype=np.int32) \
+                + self._gauge_seq
+            self._gauge_seq += n
+            gs, gv, gq = self._route(
+                self.me.gauge_slots // self.S, slots, values, seqs)
+            self.me.ingest(*self._pads_for("histo", "counter"),
+                           gs, gv, gq, *self._pads_for("set"))
+        self._ingest_batch(slots, count, mark, apply)
+
+    def ingest_set_batch(self, slots, reg_idx, rho, count=None, mark=None):
+        def apply(n):
+            ss, si, sr = self._route(
+                self.me.set_slots // self.S, slots, reg_idx, rho,
+                fill=0)
+            self.me.ingest(*self._pads_for("histo", "counter", "gauge"),
+                           ss, si, sr)
+        self._ingest_batch(slots, count, mark, apply)
+
+    # ---------------- flush ----------------
+
+    def _swap_banks(self):
+        snap = self.me.banks
+        self.me.banks = self.me._fresh_fn()
+        return snap
+
+    def _flush_device(self, snap) -> dict:
+        """Collective merge over the mesh, mapped onto the host-dict
+        contract the shared assembly consumes."""
+        dev = jax.device_get(self.me.flush_device(snap))
+        agg = dev["agg"]
+        host = {
+            "q": dev["quantiles"],
+            "c_hi": dev["c_hi"], "c_lo": dev["c_lo"],
+            "g_value": dev["gauge_val"], "g_seq": dev["gauge_seq"],
+            "s_est": dev["set_est"],
+        }
+        cols = []
+        for a in self._agg_emit:
+            if a == "count":
+                cols.append(dev["cnt_hi"])
+                host["lo_count"] = dev["cnt_lo"]
+            elif a == "sum":
+                cols.append(dev["sum_hi"])
+                host["lo_sum"] = dev["sum_lo"]
+            else:
+                cols.append(agg[a])
+        if cols:
+            host["aggcols"] = np.stack(cols, axis=1)
+        if "count" not in self._agg_emit:
+            host["cnt"] = agg["count"]
+        return host
+
+    def warmup(self):
+        """Compile the SPMD ingest + merged flush before serving."""
+        with self.lock:
+            self.me.ingest(*self._pads_for("histo", "counter", "gauge",
+                                           "set"))
+        jax.device_get(self.me.flush_device(self.me._fresh_fn()))
+        jax.block_until_ready(self.me.banks.histo.mean)
+
+    # import/Combine is not supported on the mesh tier (see module doc)
+
+    def import_histogram(self, *a, **kw):
+        raise RuntimeError("mesh engine does not accept imports")
+
+    import_set = import_counter = import_gauge = import_histogram

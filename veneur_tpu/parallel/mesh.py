@@ -129,6 +129,15 @@ class MeshEngine:
                 is_leaf=lambda x: isinstance(x, P))
             self._reset_fn = jax.jit(_reset, donate_argnums=0,
                                      out_shardings=shardings)
+        # Non-donating fresh banks: the engine-integration swap needs new
+        # banks WHILE the snapshot is still feeding the merge program, so
+        # it cannot reuse the donating reset (flush_merged's pattern).
+        # _template_banks is pure jnp construction, so jitting it yields
+        # the fresh state with no closed-over device constants.
+        out_sh = (jax.tree.map(lambda _: sds, self.banks) if self._single
+                  else shardings)
+        self._fresh_fn = jax.jit(self._template_banks,
+                                 out_shardings=out_sh)
 
     # -------------- state --------------
 
@@ -241,8 +250,10 @@ class MeshEngine:
             q = tdigest.quantile(hb, qs)
             agg = tdigest.aggregates(hb)
             est = hll.estimate(sb, force_jnp=True)
-            return (q, agg, cb.hi + cb.lo, gb.seq,
-                    jnp.where(gb.seq >= 0, gb.value, -jnp.inf), est)
+            pairs = (hb.count, hb.count_lo, hb.vsum, hb.vsum_lo)
+            return (q, agg, cb.hi, cb.lo, gb.seq,
+                    jnp.where(gb.seq >= 0, gb.value, -jnp.inf), est,
+                    pairs)
 
         return lambda banks: flush_one(banks, self.qs)
 
@@ -295,13 +306,14 @@ class MeshEngine:
             merged = tdigest._compress_impl(merged, comp)
 
             # ---- scalars / HLL: pure collectives ----
-            c_total = jax.lax.psum(cb.hi + cb.lo, "dp")
+            c_hi = jax.lax.psum(cb.hi, "dp")
+            c_lo = jax.lax.psum(cb.lo, "dp")
             g_seq = jax.lax.pmax(gb.seq, "dp")
             g_val = jax.lax.pmax(
                 jnp.where((gb.seq == g_seq) & (g_seq >= 0), gb.value,
                           -jnp.inf), "dp")
             regs = jax.lax.pmax(sb.registers.astype(jnp.int32), "dp")
-            return merged, c_total, g_seq, g_val, regs
+            return merged, c_hi, c_lo, g_seq, g_val, regs
 
         bank_spec = TDigestBank(
             mean=P("shard", None), weight=P("shard", None),
@@ -311,7 +323,7 @@ class MeshEngine:
             vsum_lo=P("shard"), count_lo=P("shard"),
             recip_lo=P("shard"))
         out_specs = (bank_spec, P("shard"), P("shard"), P("shard"),
-                     P("shard", None))
+                     P("shard"), P("shard", None))
         # check_vma=False: outputs ARE dp-replicated (they come from
         # all_gather/psum/pmax over "dp"), but the varying-axes inference
         # can't prove it for all_gather-derived values.
@@ -326,23 +338,37 @@ class MeshEngine:
             agg = tdigest.aggregates(merged)
             est = hll.estimate(hll.HLLBank(regs.astype(jnp.uint8)),
                                force_jnp=True)
-            return q, agg, est
+            pairs = (merged.count, merged.count_lo,
+                     merged.vsum, merged.vsum_lo)
+            return q, agg, est, pairs
 
         def flush(banks):
-            merged, c_total, g_seq, g_val, regs = merge_fn(*banks)
-            q, agg, est = epilogue(merged, regs, self.qs)
-            return q, agg, c_total, g_seq, g_val, est
+            merged, c_hi, c_lo, g_seq, g_val, regs = merge_fn(*banks)
+            q, agg, est, pairs = epilogue(merged, regs, self.qs)
+            return q, agg, c_hi, c_lo, g_seq, g_val, est, pairs
 
         return flush
 
     def flush_merged(self):
         """Run the merged flush, reset state, return full-K host arrays."""
-        q, agg, c_total, g_seq, g_val, est = self._flush_fn(self.banks)
-        out = jax.device_get({
-            "quantiles": q, "agg": agg, "counters": c_total,
-            "gauge_seq": g_seq, "gauge_val": g_val, "set_est": est})
+        out = jax.device_get(self.flush_device(self.banks))
         self.banks = self._reset_fn(self.banks)
         return out
+
+    def flush_device(self, banks) -> dict:
+        """Dispatch the merged-flush program on `banks`; device arrays
+        out (callers device_get). `counters` folds the 2Sum pair for
+        compatibility; `c_hi`/`c_lo` carry the exact halves."""
+        q, agg, c_hi, c_lo, g_seq, g_val, est, pairs = \
+            self._flush_fn(banks)
+        cnt_hi, cnt_lo, sum_hi, sum_lo = pairs
+        return {
+            "quantiles": q, "agg": agg, "counters": c_hi + c_lo,
+            "c_hi": c_hi, "c_lo": c_lo,
+            "gauge_seq": g_seq, "gauge_val": g_val, "set_est": est,
+            "cnt_hi": cnt_hi, "cnt_lo": cnt_lo,
+            "sum_hi": sum_hi, "sum_lo": sum_lo,
+        }
 
     # -------------- host-side batch routing helper --------------
 
@@ -352,24 +378,33 @@ class MeshEngine:
         layout ingest() expects: segment s holds the samples owned by
         shard s with slot ids rebased to the shard-local range.
 
-        Returns (out_slots, *outs, n_overflow): samples beyond a shard's
-        segment capacity are NOT packed — callers must re-route them in
-        the next batch (or size n_per_segment for the worst case); the
-        count is returned so drops are never silent."""
+        One vectorized pass (stable sort by shard + rank-within-run),
+        not one scan per shard. Returns (out_slots, *outs, n_overflow):
+        samples beyond a shard's segment capacity are NOT packed —
+        callers must re-route them in the next batch (or size
+        n_per_segment for the worst case); the count is returned so
+        drops are never silent."""
         n_dp = n_dp or self.D
         slots = np.asarray(slots)
         out_slots = np.full((n_dp, self.S * n_per_segment), -1, np.int32)
         outs = [np.full((n_dp, self.S * n_per_segment), fill,
                         np.asarray(a).dtype) for a in arrays]
-        overflow = 0
-        for s in range(self.S):
-            m = (slots >= 0) & (slots // slots_per_shard == s)
-            all_idx = np.nonzero(m)[0]
-            idx = all_idx[:n_per_segment]
-            overflow += len(all_idx) - len(idx)
-            base = s * n_per_segment
-            out_slots[dp_row, base:base + len(idx)] = (
-                slots[idx] % slots_per_shard)
-            for o, a in zip(outs, arrays):
-                o[dp_row, base:base + len(idx)] = np.asarray(a)[idx]
+        valid = np.nonzero(slots >= 0)[0]
+        if valid.size == 0:
+            return (out_slots, *outs, 0)
+        shard = slots[valid] // slots_per_shard
+        order = np.argsort(shard, kind="stable")
+        vidx = valid[order]
+        shard = shard[order]
+        # rank of each sample within its shard run: position minus the
+        # run's start offset (runs are contiguous after the stable sort)
+        starts = np.searchsorted(shard, np.arange(self.S), side="left")
+        pos = np.arange(len(shard)) - starts[shard]
+        keep = pos < n_per_segment
+        overflow = int((~keep).sum())
+        vidx, shard, pos = vidx[keep], shard[keep], pos[keep]
+        dest = shard * n_per_segment + pos
+        out_slots[dp_row, dest] = (slots[vidx] % slots_per_shard)
+        for o, a in zip(outs, arrays):
+            o[dp_row, dest] = np.asarray(a)[vidx]
         return (out_slots, *outs, overflow)

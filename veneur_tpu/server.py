@@ -28,7 +28,8 @@ import socket
 import threading
 import time
 
-from .config import Config
+from . import trace as trace_mod
+from .config import Config, _parse_interval
 from .ingest import parser
 from .metrics import FrameSet, InterMetric, MetricType
 from .models.pipeline import AggregationEngine, EngineConfig, ForwardExport
@@ -48,8 +49,11 @@ class Server:
         self.hostname = cfg.hostname or (
             "" if cfg.omit_empty_hostname else socket.gethostname())
         # Native ingest: the C++ bridge owns interning over ONE engine's
-        # slot space; its reader threads are the parallelism.
-        n_workers = 1 if cfg.native_ingest else max(1, cfg.num_workers)
+        # slot space; its reader threads are the parallelism. A mesh
+        # engine likewise owns the whole slot space (sharded over chips).
+        self._mesh_mode = cfg.tpu_num_devices > 1
+        n_workers = (1 if cfg.native_ingest or self._mesh_mode
+                     else max(1, cfg.num_workers))
         ecfg_kw = dict(
             histogram_slots=max(256, cfg.tpu_histogram_slots // n_workers),
             counter_slots=max(128, cfg.tpu_counter_slots // n_workers),
@@ -68,8 +72,18 @@ class Server:
             is_global=cfg.is_global or bool(cfg.grpc_listen_addresses),
             hostname=self.hostname,
         )
-        self.engines = [AggregationEngine(EngineConfig(**ecfg_kw))
-                        for _ in range(n_workers)]
+        if self._mesh_mode:
+            # multi-chip serving: ONE engine whose banks are sharded
+            # over a device mesh; slot routing replaces worker sharding
+            # (SURVEY §7 step 7). Forward/import stay on the cluster
+            # tier — the engine constructor enforces it.
+            from .parallel.engine import MeshAggregationEngine
+            self.engines = [MeshAggregationEngine(
+                EngineConfig(**ecfg_kw),
+                n_devices=cfg.tpu_num_devices)]
+        else:
+            self.engines = [AggregationEngine(EngineConfig(**ecfg_kw))
+                            for _ in range(n_workers)]
         self.worker_queues: list[queue.Queue] = [
             queue.Queue(maxsize=65536) for _ in range(n_workers)]
         self.native_bridge = None
@@ -98,8 +112,50 @@ class Server:
             else:
                 from .cluster.forward import HttpJsonForwarder
                 forwarder = HttpJsonForwarder(cfg.forward_address)
+        elif forwarder is None and cfg.consul_forward_service_name:
+            # discover the global tier via Consul and re-resolve on the
+            # refresh interval (consul.go; Server.RefreshDestinations)
+            from .cluster.discovery import ConsulDiscoverer
+            from .cluster.forward import DiscoveringForwarder
+            forwarder = DiscoveringForwarder(
+                ConsulDiscoverer(),
+                cfg.consul_forward_service_name,
+                refresh_interval_s=_parse_interval(
+                    cfg.consul_refresh_interval),
+                use_grpc=cfg.forward_use_grpc)
         self.forwarder = forwarder   # callable(ForwardExport) or None
         self._grpc_servers = []
+        # tags_exclude strips tag names BEFORE key construction (metrics
+        # differing only in an excluded tag aggregate together). The C++
+        # parser does not apply it; warn rather than silently differ.
+        self._exclude_tags = frozenset(cfg.tags_exclude) or None
+        if self._exclude_tags and cfg.native_ingest:
+            log.warning("tags_exclude is not applied by the native "
+                        "ingest bridge; excluded tags will remain on "
+                        "natively-parsed metrics")
+        # stats_address: ship veneur.* self-metrics there as DogStatsD
+        # over UDP (the reference's scopedstatsd client, usually pointed
+        # at the local veneur itself); unset = inject into our own flush.
+        self._stats_sock = None
+        if cfg.stats_address:
+            host, _, port = cfg.stats_address.rpartition(":")
+            fam = (socket.AF_INET6 if ":" in host.strip("[]")
+                   else socket.AF_INET)
+            self._stats_sock = socket.socket(fam, socket.SOCK_DGRAM)
+            self._stats_dest = (host.strip("[]") or "127.0.0.1",
+                                int(port))
+        # Self-tracing (flusher.go: spans around flush/forward): when an
+        # SSF UDP listener exists, point a trace client back at it so
+        # the server traces itself through its own ingest path.
+        self.trace_client = None
+        self._ssf_udp_sock = None
+        self._sentry = None
+        if cfg.sentry_dsn:
+            from .utils.sentry import SentryClient
+            self._sentry = SentryClient(cfg.sentry_dsn)
+        # per-sink flush stats from the previous interval
+        self._sink_stats: dict[str, tuple[int, float]] = {}
+        self._sink_stats_lock = threading.Lock()
 
         self._threads: list[threading.Thread] = []
         self._sockets: list[socket.socket] = []
@@ -180,7 +236,8 @@ class Server:
             out.append(SignalFxMetricSink(
                 api_key=cfg.signalfx_api_key,
                 endpoint=cfg.signalfx_endpoint_base,
-                hostname=self.hostname, tags=list(cfg.tags)))
+                hostname=self.hostname, tags=list(cfg.tags),
+                vary_key_by=cfg.signalfx_vary_key_by))
         if cfg.kafka_broker and (cfg.kafka_metric_topic or cfg.kafka_topic):
             from .sinks.kafka import KafkaMetricSink
             out.append(KafkaMetricSink(
@@ -211,6 +268,11 @@ class Server:
         out = [SSFMetricsSink(
             self._route_metric,
             indicator_span_timer_name=self.cfg.indicator_span_timer_name)]
+        if self.cfg.datadog_trace_api_address:
+            from .sinks.datadog import DatadogSpanSink
+            out.append(DatadogSpanSink(
+                trace_api_address=self.cfg.datadog_trace_api_address,
+                buffer_size=self.cfg.ssf_buffer_size))
         if self.cfg.splunk_hec_address:
             from .sinks.splunk import SplunkSpanSink
             out.append(SplunkSpanSink(
@@ -269,6 +331,12 @@ class Server:
             self._start_statsd_listener(addr)
         for addr in self.cfg.ssf_listen_addresses:
             self._start_ssf_listener(addr)
+        if self.trace_client is None and self._ssf_udp_sock is not None:
+            from . import trace
+            port = self._ssf_udp_sock.getsockname()[1]
+            self.trace_client = trace.Client(f"udp://127.0.0.1:{port}")
+        if self.cfg.enable_profiling:
+            self._start_profiling()
         for addr in self.cfg.grpc_listen_addresses:
             self._start_import_listener(addr)
         for ss in self.span_sinks:
@@ -340,6 +408,16 @@ class Server:
             try:
                 s.stop()
             except Exception:
+                pass
+        if self.trace_client is not None:
+            try:
+                self.trace_client.close()
+            except Exception:
+                pass
+        if self._stats_sock is not None:
+            try:
+                self._stats_sock.close()
+            except OSError:
                 pass
 
     # ------------- ingest -------------
@@ -519,6 +597,8 @@ class Server:
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             sock.bind(bind_addr)
             self._sockets.append(sock)
+            if self._ssf_udp_sock is None:
+                self._ssf_udp_sock = sock  # self-trace target
             t = threading.Thread(target=self._read_ssf_packet_socket,
                                  args=(sock,), name="ssf-udp-reader",
                                  daemon=True)
@@ -701,7 +781,7 @@ class Server:
             if not line:
                 continue
             try:
-                item = parser.parse_packet(line)
+                item = parser.parse_packet(line, self._exclude_tags)
             except parser.ParseError:
                 with self._stats_lock:
                     self.parse_errors += 1
@@ -769,8 +849,10 @@ class Server:
             try:
                 self.flush_once()
                 self._last_flush_ok = time.monotonic()
-            except Exception:
+            except Exception as e:
                 log.exception("flush failed")
+                if self._sentry is not None:
+                    self._sentry.capture(e, "flush failed")
 
     def flush_once(self, timestamp: int | None = None):
         """One flush tick: drain engines, fan out, forward
@@ -783,27 +865,37 @@ class Server:
         frames = []
         merged_export = ForwardExport()
         events, checks = [], []
-        for eng in self.engines:
-            res = eng.flush(timestamp=ts)
-            frames.append(res.frame)
-            merged_export.histograms.extend(res.export.histograms)
-            merged_export.sets.extend(res.export.sets)
-            merged_export.counters.extend(res.export.counters)
-            merged_export.gauges.extend(res.export.gauges)
-            ev, ch = eng.drain_events()
-            events.extend(ev)
-            checks.extend(ch)
+        with trace_mod.start_span(self.trace_client, "veneur.flush",
+                                   service="veneur"):
+            status_metrics = []
+            for eng in self.engines:
+                res = eng.flush(timestamp=ts)
+                frames.append(res.frame)
+                status_metrics.extend(res.status_metrics)
+                merged_export.histograms.extend(res.export.histograms)
+                merged_export.sets.extend(res.export.sets)
+                merged_export.counters.extend(res.export.counters)
+                merged_export.gauges.extend(res.export.gauges)
+                ev, ch = eng.drain_events()
+                events.extend(ev)
+                checks.extend(ch)
 
-        frameset = FrameSet(frames, self._self_metrics(ts, t0))
+        frameset = FrameSet(
+            frames, status_metrics + self._self_metrics(ts, t0))
         self._fan_out(frameset, events, checks)
 
         if self.forwarder is not None and (
                 merged_export.histograms or merged_export.sets
                 or merged_export.counters or merged_export.gauges):
             try:
-                self.forwarder(merged_export)
-            except Exception:
+                with trace_mod.start_span(self.trace_client,
+                                          "veneur.flush.forward",
+                                          service="veneur"):
+                    self.forwarder(merged_export)
+            except Exception as e:
                 log.exception("forward failed")
+                if self._sentry is not None:
+                    self._sentry.capture(e, "forward failed")
         self.flush_count += 1
         return frameset
 
@@ -828,10 +920,10 @@ class Server:
                       - int(last.get("drops_no_slot", 0)))
             self._last_bridge_stats = st
         dur_ns = (time.monotonic() - t0) * 1e9
-        mk = lambda name, value, mt: InterMetric(
-            name=name, timestamp=ts, value=value, tags=[],
+        mk = lambda name, value, mt, tags=(): InterMetric(
+            name=name, timestamp=ts, value=value, tags=list(tags),
             type=mt, hostname=self.hostname)
-        return [
+        out = [
             mk("veneur.packet.received_total", packets, MetricType.COUNTER),
             mk("veneur.packet.error_total", perrs, MetricType.COUNTER),
             mk("veneur.worker.dropped_total", drops, MetricType.COUNTER),
@@ -839,6 +931,35 @@ class Server:
             mk("veneur.ssf.error_total", sserrs, MetricType.COUNTER),
             mk("veneur.flush.total_duration_ns", dur_ns, MetricType.GAUGE),
         ]
+        # per-sink counts/durations from the PREVIOUS interval's fan-out
+        # (the sinks for this interval haven't run yet) — flusher.go's
+        # per-sink flush spans / sink.flushed_metrics self-metrics.
+        with self._sink_stats_lock:
+            sink_stats, self._sink_stats = self._sink_stats, {}
+        for name, (count, ns, errs) in sorted(sink_stats.items()):
+            tags = [f"sink:{name}"]
+            out.append(mk("veneur.sink.metrics_flushed_total", count,
+                          MetricType.COUNTER, tags))
+            out.append(mk("veneur.sink.flush_duration_ns", ns,
+                          MetricType.GAUGE, tags))
+            out.append(mk("veneur.sink.flush_errors_total", errs,
+                          MetricType.COUNTER, tags))
+        if self._stats_sock is not None:
+            # scopedstatsd mode: ship veneur.* over the wire to
+            # stats_address (usually this server's own statsd port)
+            # instead of injecting into this flush.
+            lines = []
+            for m in out:
+                kind = "c" if m.type == MetricType.COUNTER else "g"
+                tags = ("|#" + ",".join(m.tags)) if m.tags else ""
+                lines.append(f"{m.name}:{m.value:g}|{kind}{tags}")
+            try:
+                self._stats_sock.sendto("\n".join(lines).encode(),
+                                        self._stats_dest)
+            except OSError:
+                pass
+            return []
+        return out
 
     def _fan_out(self, frameset, events, checks):
         """Per-sink parallel flush with timeout isolation (one goroutine
@@ -848,12 +969,25 @@ class Server:
         threads = []
         for s in self.sinks:
             def run(sink=s):
+                t0 = time.monotonic()
+                ok = False
                 try:
                     sink.flush_frames(frameset)
                     if events or checks:
                         sink.flush_other(events, checks)
+                    ok = True
                 except Exception:
                     log.exception("sink %s flush failed", sink.name())
+                finally:
+                    # reported in the NEXT interval's veneur.sink.*
+                    # self-metrics (flusher.go per-sink spans); a failed
+                    # flush reports 0 flushed + an error count, so a
+                    # down vendor is visible, not masked
+                    with self._sink_stats_lock:
+                        self._sink_stats[sink.name()] = (
+                            len(frameset) if ok else 0,
+                            (time.monotonic() - t0) * 1e9,
+                            0 if ok else 1)
             t = threading.Thread(target=run, daemon=True,
                                  name=f"sink-{s.name()}")
             t.start()
@@ -883,6 +1017,26 @@ class Server:
         for t in threads:
             t.join(max(0.0, deadline - time.monotonic()))
 
+    def _start_profiling(self):
+        """enable_profiling: expose the JAX/XLA profiler (xprof) — the
+        TPU build's analogue of the reference's net/http/pprof wiring
+        (server.go). mutex_profile_fraction / block_profile_rate are
+        Go-runtime knobs with no XLA equivalent; they are accepted for
+        YAML compatibility and warned about, not silently eaten."""
+        if self.cfg.mutex_profile_fraction or self.cfg.block_profile_rate:
+            log.warning("mutex_profile_fraction/block_profile_rate are "
+                        "Go-runtime profiling knobs with no effect in "
+                        "the TPU build; use enable_profiling (JAX "
+                        "profiler) instead")
+        try:
+            import jax
+            port = self.cfg.profile_port
+            jax.profiler.start_server(port)
+            log.info("JAX profiler server on :%d", port)
+        except Exception as e:
+            log.warning("enable_profiling: JAX profiler unavailable: %s",
+                        e)
+
     # ------------- watchdog -------------
 
     def _watchdog(self):
@@ -897,4 +1051,10 @@ class Server:
                     "flush watchdog: no completed flush in %.1fs "
                     "(max %.1fs) — exiting for supervisor restart",
                     lag, max_lag)
+                if self._sentry is not None:
+                    # ConsumePanic: the event must escape the dying
+                    # process, so this send blocks (bounded)
+                    self._sentry.capture(
+                        None, "flush watchdog expired; crash-only exit",
+                        wait=True)
                 os._exit(2)

@@ -20,11 +20,12 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import urllib.request
 import zlib
 
 from ..metrics import InterMetric, MetricType
-from . import MetricSink
+from . import MetricSink, SpanSink
 
 log = logging.getLogger("veneur_tpu.sinks.datadog")
 
@@ -78,8 +79,14 @@ class DatadogMetricSink(MetricSink):
                     f"datadog POST {path}: HTTP {resp.status}")
 
     def flush(self, metrics):
-        series = [self._series(m) for m in metrics]
+        series, checks = [], []
+        for m in metrics:
+            if m.type == MetricType.STATUS:
+                checks.append(m)
+            else:
+                series.append(self._series(m))
         self._post_series(series)
+        self._post_status(checks)
 
     def _post_series(self, series):
         for i in range(0, len(series), self.flush_max_per_body):
@@ -143,10 +150,31 @@ class DatadogMetricSink(MetricSink):
                             s["device_name"] = device
                         app(s)
         name = self.name()
+        checks = []
         for x in frames.extra:
             if not x.sinks or name in x.sinks:
-                app(self._series(x))
+                if x.type == MetricType.STATUS:
+                    checks.append(x)
+                else:
+                    app(self._series(x))
         self._post_series(series)
+        self._post_status(checks)
+
+    def _post_status(self, status_metrics):
+        """Status-typed InterMetrics (the StatusCheck sampler's flush
+        shape) become Datadog service checks — the reference's datadog
+        sink does the same conversion at flush."""
+        for m in status_metrics:
+            body = {"check": m.name, "status": int(m.value),
+                    "tags": list(m.tags), "message": m.message}
+            if m.timestamp:
+                body["timestamp"] = m.timestamp
+            if m.hostname:
+                body["host_name"] = m.hostname
+            try:
+                self._post("/api/v1/check_run", body)
+            except Exception as ex:
+                log.warning("datadog check post failed: %s", ex)
 
     def flush_other(self, events, checks):
         for e in events:
@@ -177,3 +205,75 @@ class DatadogMetricSink(MetricSink):
                 self._post("/api/v1/check_run", body)
             except Exception as ex:
                 log.warning("datadog check post failed: %s", ex)
+
+
+class DatadogSpanSink(SpanSink):
+    """SSF spans → Datadog APM traces (sinks/datadog/datadog.go sym:
+    DatadogSpanSink): buffer ingested spans, group by trace id, and PUT
+    them to a local Datadog trace agent's /v0.3/traces endpoint as the
+    agent's JSON list-of-traces format. Nanosecond SSF timestamps map
+    straight onto the agent's start/duration fields."""
+
+    def __init__(self, trace_api_address: str = "http://127.0.0.1:8126",
+                 buffer_size: int = 16384, timeout_s: float = 10.0):
+        self.trace_api_address = trace_api_address.rstrip("/")
+        self.buffer_size = buffer_size
+        self.timeout_s = timeout_s
+        self._spans: list = []
+        self._lock = threading.Lock()
+        self.dropped_total = 0
+        self.flushed_total = 0
+
+    def name(self) -> str:
+        return "datadog"
+
+    def ingest(self, span):
+        # spans with no timing/ids are metric carriers, not traces
+        if not span.trace_id or not span.id or not span.start_timestamp:
+            return
+        with self._lock:
+            if len(self._spans) >= self.buffer_size:
+                self.dropped_total += 1
+                return
+            self._spans.append(span)
+
+    @staticmethod
+    def _convert(span) -> dict:
+        dur = max(0, (span.end_timestamp or span.start_timestamp)
+                  - span.start_timestamp)
+        d = {
+            "trace_id": span.trace_id,
+            "span_id": span.id,
+            "parent_id": span.parent_id,
+            "name": span.name,
+            "resource": span.tags.get("resource", span.name),
+            "service": span.service,
+            "start": span.start_timestamp,
+            "duration": dur,
+            "error": 1 if span.error else 0,
+            "meta": dict(span.tags),
+        }
+        return d
+
+    def flush(self):
+        with self._lock:
+            spans, self._spans = self._spans, []
+        if not spans:
+            return
+        traces: dict[int, list] = {}
+        for s in spans:
+            traces.setdefault(s.trace_id, []).append(self._convert(s))
+        body = json.dumps(list(traces.values())).encode()
+        req = urllib.request.Request(
+            f"{self.trace_api_address}/v0.3/traces", data=body,
+            headers={"Content-Type": "application/json"}, method="PUT")
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as resp:
+                if resp.status >= 400:
+                    raise RuntimeError(f"HTTP {resp.status}")
+            self.flushed_total += len(spans)
+        except Exception as e:
+            self.dropped_total += len(spans)
+            log.warning("datadog trace flush failed "
+                        "(%d spans dropped): %s", len(spans), e)

@@ -19,7 +19,7 @@ import json
 import logging
 import threading
 
-from ..metrics import InterMetric
+from ..metrics import InterMetric, MetricType
 from . import MetricSink, SpanSink
 
 log = logging.getLogger("veneur_tpu.sinks.kafka")
@@ -77,6 +77,8 @@ class KafkaMetricSink(MetricSink):
                 self.dropped_total += len(metrics)
             return
         for m in metrics:
+            if m.type == MetricType.STATUS:
+                continue  # service checks are Datadog-shaped; skip
             # key by series identity: one series → one partition, so
             # per-series ordering survives (the reference's partition key)
             key = f"{m.name}|{','.join(m.tags)}".encode()

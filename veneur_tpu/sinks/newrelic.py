@@ -81,6 +81,8 @@ class NewRelicMetricSink(MetricSink):
             return False
 
     def flush(self, metrics):
+        metrics = [m for m in metrics
+                   if m.type != MetricType.STATUS]  # datadog-shaped
         if not metrics:
             return
         payload = [{"metrics": [self._metric(m) for m in metrics]}]

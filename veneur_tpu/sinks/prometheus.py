@@ -151,6 +151,8 @@ class PrometheusMetricSink(MetricSink):
                          name="prometheus-sink", daemon=True).start()
 
     def flush(self, metrics):
+        metrics = [m for m in metrics
+                   if m.type != MetricType.STATUS]  # datadog-shaped
         with self._lock:
             self._body = render(metrics, self._counter_totals).encode()
             self._counter_totals.advance()

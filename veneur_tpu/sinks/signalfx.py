@@ -54,6 +54,8 @@ class SignalFxMetricSink(MetricSink):
     def flush(self, metrics):
         by_token: dict[str, dict] = {}
         for m in metrics:
+            if m.type == MetricType.STATUS:
+                continue  # service checks are Datadog-shaped; skip
             dp = {"metric": m.name, "timestamp": m.timestamp * 1000,
                   "value": m.value, "dimensions": self._dims(m)}
             kind = ("counter" if m.type == MetricType.COUNTER else "gauge")
