@@ -190,9 +190,11 @@ struct ParsedMetric {
 
 // Parse one line. `scratch` vectors are caller-provided to avoid per-line
 // allocation on the hot path.
-ParseVerdict parse_line(const uint8_t* data, size_t len, ParsedMetric* m,
-                        std::vector<std::pair<const uint8_t*, size_t>>* secs,
-                        std::vector<std::pair<const uint8_t*, size_t>>* tags) {
+ParseVerdict parse_line(
+    const uint8_t* data, size_t len, ParsedMetric* m,
+    std::vector<std::pair<const uint8_t*, size_t>>* secs,
+    std::vector<std::pair<const uint8_t*, size_t>>* tags,
+    const std::vector<std::string>* exclude = nullptr) {
   if (len == 0) return P_ERROR;
   if (len >= 3 && memcmp(data, "_e{", 3) == 0) return P_OTHER;
   if (len >= 4 && memcmp(data, "_sc|", 4) == 0) return P_OTHER;
@@ -296,6 +298,26 @@ ParseVerdict parse_line(const uint8_t* data, size_t len, ParsedMetric* m,
         if (!comma) break;
         remain -= tlen + 1;
         p = comma + 1;
+      }
+      if (exclude && !exclude->empty()) {
+        // tags_exclude semantics (config.go): drop tags whose NAME
+        // (before ':', or the whole tag) matches, BEFORE the key is
+        // built, so excluded-tag variants aggregate together
+        tags->erase(
+            std::remove_if(
+                tags->begin(), tags->end(),
+                [&](const std::pair<const uint8_t*, size_t>& t) {
+                  const uint8_t* colon = static_cast<const uint8_t*>(
+                      memchr(t.first, ':', t.second));
+                  size_t nlen = colon
+                      ? static_cast<size_t>(colon - t.first) : t.second;
+                  for (const std::string& ex : *exclude)
+                    if (ex.size() == nlen &&
+                        memcmp(ex.data(), t.first, nlen) == 0)
+                      return true;
+                  return false;
+                }),
+            tags->end());
       }
       // byte-wise sort == code-point sort for valid UTF-8
       std::sort(tags->begin(), tags->end(),
@@ -460,6 +482,9 @@ struct Bridge {
   std::mutex newkeys_mu;
   std::deque<NewKey> newkeys;
 
+  // set ONCE before readers start (no synchronization on the hot path)
+  std::vector<std::string> tags_exclude;
+
   std::mutex other_mu;
   std::deque<std::string> other;
   size_t other_cap = 65536;
@@ -586,7 +611,9 @@ void route_other(Bridge* br, const uint8_t* line, size_t len) {
 void handle_line(Bridge* br, LocalStage* st, const uint8_t* line,
                  size_t len) {
   br->lines.fetch_add(1, std::memory_order_relaxed);
-  ParseVerdict v = parse_line(line, len, &st->m, &st->secs, &st->tags);
+  ParseVerdict v = parse_line(
+      line, len, &st->m, &st->secs, &st->tags,
+      br->tags_exclude.empty() ? nullptr : &br->tags_exclude);
   if (v == P_ERROR) {
     br->parse_errors.fetch_add(1, std::memory_order_relaxed);
     return;
@@ -939,6 +966,24 @@ int32_t vtpu_intern(void* h, int32_t mtype, int32_t scope,
   m.digest = hh;
   build_key(m, &keybuf);
   return intern_key(br, m, keybuf);
+}
+
+// Install the tags_exclude list: '\n'-joined tag names. MUST be called
+// before vtpu_start_udp (readers snapshot nothing; the list is read
+// lock-free on the hot path).
+void vtpu_set_tags_exclude(void* h, const uint8_t* packed, int32_t len) {
+  Bridge* br = static_cast<Bridge*>(h);
+  br->tags_exclude.clear();
+  size_t start = 0;
+  std::string all(reinterpret_cast<const char*>(packed),
+                  static_cast<size_t>(len));
+  while (start <= all.size() && len > 0) {
+    size_t nl = all.find('\n', start);
+    size_t end = (nl == std::string::npos) ? all.size() : nl;
+    if (end > start) br->tags_exclude.emplace_back(all, start, end - start);
+    if (nl == std::string::npos) break;
+    start = nl + 1;
+  }
 }
 
 int64_t vtpu_key_count(void* h, int32_t bank) {

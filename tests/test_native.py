@@ -313,3 +313,29 @@ class TestAdvisorRegressions:
         finally:
             a.close()
             b.close()
+
+    def test_tags_exclude_in_cpp_parser(self):
+        """tags_exclude is applied by the C++ parser before key
+        construction, matching the Python parser's semantics."""
+        br = native.NativeBridge(histo_slots=64, counter_slots=64,
+                                 gauge_slots=64, set_slots=64,
+                                 hll_precision=14, idle_ttl=4,
+                                 ring_capacity=4096, max_packet=8192)
+        try:
+            br.set_tags_exclude(["pod_id", "debug"])
+            br.handle_packet(b"m:1|c|#env:p,pod_id:a\n"
+                             b"m:2|c|#env:p,pod_id:b\n"
+                             b"m:3|c|#debug,env:p")
+            keys = br.drain_new_keys()
+            assert len(keys) == 1          # all three merged to one key
+            assert keys[0][5] == "env:p"   # joined_tags
+            got, slots, vals, _, _ = poll_all(br, "counter")
+            assert got == 3
+            assert sorted(vals.tolist()) == [1.0, 2.0, 3.0]
+            # digest parity with the Python parser under the same excl.
+            pm = parser.parse_metric(b"m:1|c|#env:p,pod_id:a",
+                                     frozenset(["pod_id", "debug"]))
+            assert hashing.metric_digest(
+                keys[0][4], "counter", keys[0][5]) == pm.digest
+        finally:
+            br.close()

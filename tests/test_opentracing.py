@@ -1,0 +1,82 @@
+"""OpenTracing bridge: API shape, propagation, SSF emission
+(trace/opentracing.go parity)."""
+
+import pytest
+
+from veneur_tpu.ssf.protos import ssf_pb2
+from veneur_tpu.trace import opentracing as ot
+
+
+class FakeClient:
+    def __init__(self):
+        self.spans = []
+
+    def record(self, span):
+        self.spans.append(span)
+        return True
+
+
+def test_span_hierarchy_and_ssf_emission():
+    client = FakeClient()
+    tracer = ot.Tracer(client, "websvc")
+    with tracer.start_active_span("parent", tags={"route": "/x"}) as sc:
+        assert tracer.active_span is sc.span
+        with tracer.start_active_span("child") as cc:
+            cc.span.log_kv({"event": "cache-miss"})
+    assert tracer.active_span is None
+    assert len(client.spans) == 2
+    child, parent = client.spans       # child finishes first
+    assert isinstance(parent, ssf_pb2.SSFSpan)
+    assert parent.name == "parent" and parent.service == "websvc"
+    assert child.trace_id == parent.trace_id
+    assert child.parent_id == parent.id
+    assert parent.parent_id == 0
+    assert parent.tags["route"] == "/x"
+    assert parent.end_timestamp >= parent.start_timestamp
+
+
+def test_error_tagging_via_context_manager():
+    client = FakeClient()
+    tracer = ot.Tracer(client, "svc")
+    with pytest.raises(ValueError):
+        with tracer.start_span("boom"):
+            raise ValueError("x")
+    assert client.spans[0].error is True
+
+
+def test_textmap_inject_extract_roundtrip():
+    tracer = ot.Tracer(None, "svc")
+    span = tracer.start_span("op")
+    span.set_baggage_item("tenant", "acme")
+    carrier: dict = {}
+    tracer.inject(span.context, ot.FORMAT_HTTP_HEADERS, carrier)
+    assert carrier[ot.TRACE_ID_KEY] == str(span.context.trace_id)
+    ctx = tracer.extract(ot.FORMAT_TEXT_MAP, carrier)
+    assert ctx.trace_id == span.context.trace_id
+    assert ctx.span_id == span.context.span_id
+    assert ctx.baggage == {"tenant": "acme"}
+    # a remote child continues the trace
+    child = tracer.start_span("remote", child_of=ctx)
+    assert child.context.trace_id == span.context.trace_id
+    assert child.parent_id == span.context.span_id
+
+
+def test_binary_roundtrip_and_corruption():
+    tracer = ot.Tracer(None, "svc")
+    span = tracer.start_span("op")
+    buf = bytearray()
+    tracer.inject(span.context, ot.FORMAT_BINARY, buf)
+    ctx = tracer.extract(ot.FORMAT_BINARY, buf)
+    assert (ctx.trace_id, ctx.span_id) == (span.context.trace_id,
+                                           span.context.span_id)
+    with pytest.raises(ot.SpanContextCorruptedException):
+        tracer.extract(ot.FORMAT_TEXT_MAP, {"nope": "1"})
+    with pytest.raises(ot.UnsupportedFormatException):
+        tracer.inject(span.context, "jaeger-custom", {})
+
+
+def test_finish_is_idempotent_and_unsampled_tracer_safe():
+    tracer = ot.Tracer(None, "svc")    # no client: spans are dropped
+    s = tracer.start_span("op")
+    s.finish()
+    s.finish()

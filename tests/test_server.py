@@ -1,6 +1,7 @@
 """End-to-end server tests: a real Server on loopback UDP with a capturing
 fake sink (the server_test.go strategy), plus config parsing."""
 
+import os
 import socket
 import time
 
@@ -149,3 +150,23 @@ def test_forwarder_receives_exports():
         assert "fwd.hist.50percentile" not in names
     finally:
         srv.stop()
+
+
+def test_example_yaml_is_complete_and_loads():
+    """example.yaml documents every Config key (the reference documents
+    its whole surface in example.yaml) and round-trips through
+    read_config."""
+    import dataclasses
+
+    import yaml
+
+    from veneur_tpu import config as config_mod
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "example.yaml")
+    keys = set(yaml.safe_load(open(path)))
+    fields = {f.name for f in dataclasses.fields(config_mod.Config)}
+    assert keys == fields - {"is_global"}   # loader-populated, not YAML
+    cfg = config_mod.read_config(path)
+    assert cfg.interval_seconds == 10.0
+    assert cfg.tpu_compression == 100.0

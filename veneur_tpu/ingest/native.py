@@ -110,6 +110,8 @@ def load() -> ctypes.CDLL:
                                     ctypes.c_int32, u8p, ctypes.c_int32,
                                     u8p, ctypes.c_int32]
         lib.vtpu_stats.argtypes = [ctypes.c_void_p, u64p]
+        lib.vtpu_set_tags_exclude.argtypes = [ctypes.c_void_p, u8p,
+                                              ctypes.c_int32]
         lib.vtpu_parse_one.restype = ctypes.c_int32
         lib.vtpu_parse_one.argtypes = [u8p, ctypes.c_int32, u8p,
                                        ctypes.c_int32, i32p]
@@ -206,6 +208,15 @@ class NativeBridge:
         arr = np.frombuffer(bytearray(data), np.uint8) if data else \
             np.zeros(1, np.uint8)
         self._lib.vtpu_handle_packet(self._h, _u8(arr), len(data))
+
+    def set_tags_exclude(self, names) -> None:
+        """Install tags_exclude (config.go sym: Config.TagsExclude) in
+        the C++ parser. Must be called BEFORE start_udp — the list is
+        read lock-free by the reader threads."""
+        packed = "\n".join(names).encode()
+        arr = np.frombuffer(bytearray(packed), np.uint8) if packed else \
+            np.zeros(1, np.uint8)
+        self._lib.vtpu_set_tags_exclude(self._h, _u8(arr), len(packed))
 
     def start_udp(self, host: str, port: int, n_readers: int,
                   rcvbuf: int = 0) -> int:

@@ -126,13 +126,12 @@ class Server:
         self.forwarder = forwarder   # callable(ForwardExport) or None
         self._grpc_servers = []
         # tags_exclude strips tag names BEFORE key construction (metrics
-        # differing only in an excluded tag aggregate together). The C++
-        # parser does not apply it; warn rather than silently differ.
+        # differing only in an excluded tag aggregate together), in both
+        # the Python parser and the C++ bridge's.
         self._exclude_tags = frozenset(cfg.tags_exclude) or None
-        if self._exclude_tags and cfg.native_ingest:
-            log.warning("tags_exclude is not applied by the native "
-                        "ingest bridge; excluded tags will remain on "
-                        "natively-parsed metrics")
+        if self._exclude_tags and self.native_bridge is not None:
+            self.native_bridge.set_tags_exclude(sorted(
+                self._exclude_tags))
         # stats_address: ship veneur.* self-metrics there as DogStatsD
         # over UDP (the reference's scopedstatsd client, usually pointed
         # at the local veneur itself); unset = inject into our own flush.
@@ -206,9 +205,11 @@ class Server:
 
         def slow_path(line: bytes):
             """Lines the C++ parser routes to Python: events, service
-            checks, CPython-float oddities, invalid UTF-8."""
+            checks, CPython-float oddities, invalid UTF-8. Must apply
+            the same tags_exclude as the fast path or one logical
+            metric splits into two series."""
             try:
-                item = parser.parse_packet(line)
+                item = parser.parse_packet(line, self._exclude_tags)
             except parser.ParseError:
                 with self._stats_lock:
                     self.parse_errors += 1
