@@ -1,0 +1,98 @@
+"""Validate the Pallas HLL estimator on REAL TPU hardware.
+
+VERDICT r2 weak #10: the Pallas streaming-stats kernel
+(ops/pallas_hll.py) only ever ran in interpret mode in CI; this script
+runs it on the actual chip against the pure-jnp estimator over adversarial
+register patterns and random banks, checks bitwise/near equality, and
+measures the HBM-bandwidth win. Run from the repo root (the axon plugin
+only registers there):
+
+    timeout 300 python native/pallas_validate.py
+
+Writes PALLAS_VALIDATION.json on success.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform not in ("tpu", "axon"):
+        print(json.dumps({"ok": False,
+                          "reason": f"platform={dev.platform}, need tpu"}))
+        return 1
+
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import hll
+    from veneur_tpu.ops.pallas_hll import hll_stats
+
+    rng = np.random.default_rng(0)
+    K, m = 4096, 1 << 14
+    cases = {
+        "zeros": np.zeros((K, m), np.uint8),
+        "ones": np.ones((K, m), np.uint8),
+        "max_rho": np.full((K, m), 51, np.uint8),
+        "random": rng.integers(0, 52, (K, m)).astype(np.uint8),
+        "sparse": (rng.random((K, m)) < 0.01).astype(np.uint8) * 30,
+        "row_mix": np.where(
+            (np.arange(K)[:, None] % 7 == 0), 0,
+            rng.integers(0, 30, (K, m))).astype(np.uint8),
+    }
+    report = {"platform": dev.platform, "K": K, "m": m, "cases": {}}
+    worst = 0.0
+    for name, regs in cases.items():
+        bank = hll.HLLBank(jax.device_put(jnp.asarray(regs), dev))
+        ez_p, zs_p = jax.device_get(jax.jit(hll_stats)(bank.registers))
+        est_p = jax.device_get(hll._estimate_pallas(bank))
+        est_j = jax.device_get(hll._estimate_jnp(bank))
+        ez_ref = (regs == 0).sum(axis=1).astype(np.float32)
+        assert np.array_equal(np.asarray(ez_p), ez_ref), f"{name}: ez"
+        zs_ref = np.exp2(-regs.astype(np.float64)).sum(axis=1)
+        zerr = float(np.abs(np.asarray(zs_p, np.float64) - zs_ref).max()
+                     / max(zs_ref.max(), 1e-9))
+        denom = np.maximum(np.abs(np.asarray(est_j, np.float64)), 1.0)
+        eerr = float((np.abs(np.asarray(est_p, np.float64)
+                             - np.asarray(est_j, np.float64))
+                      / denom).max())
+        report["cases"][name] = {"zsum_max_rel_err": zerr,
+                                 "est_vs_jnp_max_rel_err": eerr}
+        worst = max(worst, eerr, zerr)
+        print(f"  {name}: est rel err vs jnp = {eerr:.2e}")
+
+    # perf: streaming kernel vs jnp two-pass over the u8 register file
+    bank = hll.HLLBank(jax.device_put(
+        jnp.asarray(cases["random"]), dev))
+    for fn, label in ((hll._estimate_pallas, "pallas"),
+                      (hll._estimate_jnp, "jnp")):
+        jax.block_until_ready(fn(bank))
+        t0 = time.perf_counter()
+        for _ in range(20):
+            out = fn(bank)
+        jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) / 20 * 1e3
+        report[f"{label}_ms"] = round(ms, 3)
+        print(f"  {label}: {ms:.3f} ms for [{K}, {m}] u8")
+
+    report["ok"] = worst < 1e-4
+    report["worst_rel_err"] = worst
+    with open("PALLAS_VALIDATION.json", "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"ok": report["ok"], "worst_rel_err": worst,
+                      "pallas_ms": report.get("pallas_ms"),
+                      "jnp_ms": report.get("jnp_ms")}))
+    return 0 if report["ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -869,8 +869,12 @@ class Server:
         with trace_mod.start_span(self.trace_client, "veneur.flush",
                                    service="veneur"):
             status_metrics = []
+            eng_stats = {"samples": 0, "dropped_no_slot": 0,
+                         "swap_ns": 0, "merge_ns": 0, "assembly_ns": 0}
             for eng in self.engines:
                 res = eng.flush(timestamp=ts)
+                for k in eng_stats:
+                    eng_stats[k] += res.stats.get(k, 0)
                 frames.append(res.frame)
                 status_metrics.extend(res.status_metrics)
                 merged_export.histograms.extend(res.export.histograms)
@@ -882,7 +886,8 @@ class Server:
                 checks.extend(ch)
 
         frameset = FrameSet(
-            frames, status_metrics + self._self_metrics(ts, t0))
+            frames,
+            status_metrics + self._self_metrics(ts, t0, eng_stats))
         self._fan_out(frameset, events, checks)
 
         if self.forwarder is not None and (
@@ -900,8 +905,10 @@ class Server:
         self.flush_count += 1
         return frameset
 
-    def _self_metrics(self, ts: int, t0: float) -> list[InterMetric]:
-        """veneur.* self-telemetry (the internal statsd client's names)."""
+    def _self_metrics(self, ts: int, t0: float,
+                      eng_stats: dict | None = None) -> list[InterMetric]:
+        """veneur.* self-telemetry (the internal statsd client's names,
+        incl. the reference's flush.*_duration_ns phase breakdown)."""
         with self._stats_lock:
             packets, self.packets_received = self.packets_received, 0
             perrs, self.parse_errors = self.parse_errors, 0
@@ -910,15 +917,23 @@ class Server:
             sserrs, self.ssf_errors = self.ssf_errors, 0
         if self.native_bridge is not None:
             # UDP in native mode is counted in the bridge; fold in the
-            # per-interval deltas
+            # per-interval deltas. Drop taxonomy: ring/backpressure
+            # drops -> worker.dropped_total; bank-full drops -> the
+            # dropped_no_slot metric, REPLACING the engine's own count
+            # (the BridgeKeyView only sees the slow-path subset, which
+            # the bridge counter already includes — adding both would
+            # double-report).
             st = self.native_bridge.stats()
             last = getattr(self, "_last_bridge_stats", None) or {}
             packets += int(st["packets"]) - int(last.get("packets", 0))
             perrs += int(st["parse_errors"]) - int(
                 last.get("parse_errors", 0))
-            drops += (int(st["ring_drops"]) + int(st["drops_no_slot"])
-                      - int(last.get("ring_drops", 0))
-                      - int(last.get("drops_no_slot", 0)))
+            drops += (int(st["ring_drops"])
+                      - int(last.get("ring_drops", 0)))
+            if eng_stats is not None:
+                eng_stats["dropped_no_slot"] = (
+                    int(st["drops_no_slot"])
+                    - int(last.get("drops_no_slot", 0)))
             self._last_bridge_stats = st
         dur_ns = (time.monotonic() - t0) * 1e9
         mk = lambda name, value, mt, tags=(): InterMetric(
@@ -932,6 +947,19 @@ class Server:
             mk("veneur.ssf.error_total", sserrs, MetricType.COUNTER),
             mk("veneur.flush.total_duration_ns", dur_ns, MetricType.GAUGE),
         ]
+        if eng_stats is not None:
+            out += [
+                mk("veneur.samples.processed_total",
+                   eng_stats["samples"], MetricType.COUNTER),
+                mk("veneur.samples.dropped_no_slot_total",
+                   eng_stats["dropped_no_slot"], MetricType.COUNTER),
+                mk("veneur.flush.swap_duration_ns",
+                   eng_stats["swap_ns"], MetricType.GAUGE),
+                mk("veneur.flush.merge_duration_ns",
+                   eng_stats["merge_ns"], MetricType.GAUGE),
+                mk("veneur.flush.assembly_duration_ns",
+                   eng_stats["assembly_ns"], MetricType.GAUGE),
+            ]
         # per-sink counts/durations from the PREVIOUS interval's fan-out
         # (the sinks for this interval haven't run yet) — flusher.go's
         # per-sink flush spans / sink.flushed_metrics self-metrics.
