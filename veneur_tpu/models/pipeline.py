@@ -185,7 +185,6 @@ class EngineConfig:
     forward_enabled: bool = False
     is_global: bool = False      # global tier: emit percentiles for imports
     hostname: str = ""
-    host_tags: tuple = ()
 
 
 @dataclass
