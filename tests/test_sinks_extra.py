@@ -311,3 +311,74 @@ class TestConfigWiring:
         assert "kafka" in span_names and "lightstep" in span_names
         plugin_names = sorted(p.name() for p in srv.plugins)
         assert plugin_names == ["localfile", "s3"]
+
+
+# ---------------- signalfx ----------------
+
+class TestSignalFx:
+    def _make(self, posts, **kw):
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+
+        sink = SignalFxMetricSink(api_key="default-token",
+                                  endpoint="http://x", hostname="h",
+                                  tags=["global:yes"], **kw)
+        import json as _json
+        import urllib.request
+
+        class FakeResp:
+            status = 200
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def fake_open(req, timeout=None):
+            posts.append((req.headers.get("X-sf-token"),
+                          _json.loads(req.data)))
+            return FakeResp()
+
+        self._orig = urllib.request.urlopen
+        urllib.request.urlopen = fake_open
+        return sink
+
+    def teardown_method(self):
+        import urllib.request
+        urllib.request.urlopen = self._orig
+
+    def test_datapoints_and_dimensions(self):
+        posts = []
+        sink = self._make(posts)
+        sink.flush([im("req.count", 6, MetricType.COUNTER,
+                       tags=["svc:web"]),
+                    im("cpu", 0.5, MetricType.GAUGE)])
+        (token, body), = posts
+        assert token == "default-token"
+        cnt, = body["counter"]
+        assert cnt["metric"] == "req.count" and cnt["value"] == 6
+        assert cnt["dimensions"] == {"host": "h", "global": "yes",
+                                     "svc": "web"}
+        g, = body["gauge"]
+        assert g["metric"] == "cpu" and g["timestamp"] == 1000 * 1000
+
+    def test_vary_key_by_routes_tokens(self):
+        posts = []
+        sink = self._make(posts, vary_key_by="team",
+                          per_tag_keys={"db": "db-token"})
+        sink.flush([im("a", 1, MetricType.GAUGE, tags=["team:db"]),
+                    im("b", 2, MetricType.GAUGE, tags=["team:web"]),
+                    im("c", 3, MetricType.GAUGE)])
+        tokens = sorted(t for t, _ in posts)
+        # team:db -> its own token; unknown team + untagged -> default
+        assert tokens == ["db-token", "default-token"]
+        by_token = {t: body for t, body in posts}
+        assert [d["metric"] for d in by_token["db-token"]["gauge"]] == ["a"]
+        assert sorted(d["metric"] for d in
+                      by_token["default-token"]["gauge"]) == ["b", "c"]
+
+    def test_status_metrics_skipped(self):
+        posts = []
+        sink = self._make(posts)
+        sink.flush([im("svc.check", 2, MetricType.STATUS)])
+        assert posts == []
