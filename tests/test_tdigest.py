@@ -31,7 +31,9 @@ def _bank_quantiles(values, weights=None, compression=100.0, buf_size=256,
     return bank, out[1]
 
 
-@pytest.mark.parametrize("dist", ["uniform", "normal", "lognormal", "sequential"])
+@pytest.mark.parametrize("dist", ["uniform", "normal", "lognormal",
+                                  "sequential", "bimodal", "constant",
+                                  "heavy_tail", "negative_mixed"])
 def test_quantile_accuracy_vs_exact(dist):
     rng = np.random.default_rng(42)
     n = 50_000
@@ -41,6 +43,15 @@ def test_quantile_accuracy_vs_exact(dist):
         data = rng.normal(50, 10, n)
     elif dist == "lognormal":
         data = rng.lognormal(3, 1, n)
+    elif dist == "bimodal":
+        data = np.concatenate([rng.normal(10, 1, n // 2),
+                               rng.normal(1000, 5, n - n // 2)])
+    elif dist == "constant":
+        data = np.full(n, 42.5)
+    elif dist == "heavy_tail":
+        data = rng.pareto(1.5, n) * 10 + 1   # long right tail
+    elif dist == "negative_mixed":
+        data = rng.normal(-500, 200, n)
     else:
         data = np.arange(n, dtype=np.float64)
     data = data.astype(np.float32)

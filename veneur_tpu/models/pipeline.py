@@ -5,9 +5,11 @@ Worker.ProcessMetric down through Server.Flush (worker.go, flusher.go):
 
   ingest thread:  parsed UDPMetric -> host staging buffers (numpy, fixed
                   batch shape) -> one scatter program per full batch
-  flush tick:     compress + quantiles + aggregates + estimates as a few
-                  large XLA calls over the whole bank -> device_get once ->
-                  host assembles InterMetrics from the slot->key map
+  flush tick:     ONE fused XLA program over all four banks (compress +
+                  quantiles + aggregates + HLL estimate + scalar
+                  finalization) -> one device_get of compact arrays ->
+                  host assembles a columnar MetricFrame from the
+                  slot->key map
 
 Interval semantics match Worker.Flush's map swap: flush takes the current
 immutable device arrays (JAX arrays are persistent, so the "swap" is just
