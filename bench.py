@@ -303,10 +303,18 @@ def main() -> int:
         return TOTAL_BUDGET_S - (time.monotonic() - t_start)
 
     platform = "auto"
+    from veneur_tpu.utils.platform import tunnel_alive
+    if not tunnel_alive():
+        _log("axon relay ports refused — tunnel dead; pinning cpu "
+             "for the whole budget")
+        platform = "cpu"
     # Phase 1: small K — proves the platform works and warms nothing
     # shared (workers are separate processes), cheap on any backend.
     r_small = _run_worker(10_000, min(remaining() - 60.0, 150.0), platform)
-    if r_small is None:
+    if r_small is None and platform == "auto":
+        # the cpu fallback only makes sense when the failed attempt was
+        # on the default (tunneled) platform; re-running an identical
+        # cpu config would burn budget on a known-bad configuration
         _log("default platform failed at k=10k; falling back to pinned cpu")
         platform = "cpu"
         r_small = _run_worker(10_000, min(remaining() - 10.0, 120.0), platform)

@@ -28,10 +28,16 @@ import time
 
 import numpy as np
 
+from veneur_tpu.utils.platform import pin_cpu, tunnel_alive
+
 if os.environ.get("VENEUR_BENCH_CPU", "") not in ("", "0"):
-    # the tunneled TPU can wedge for minutes; callers that detect that
-    # (or want a host-only baseline) pin the whole suite to CPU
-    from veneur_tpu.utils.platform import pin_cpu
+    # explicit host-only baseline
+    pin_cpu()
+elif not tunnel_alive():
+    # dead relay: every backend init would hang in the axon client's
+    # connect-retry loop; pin cpu and record real numbers instead
+    print(json.dumps({"metric": "tunnel_dead_cpu_fallback", "value": 1,
+                      "unit": "bool", "vs_baseline": 0}))
     pin_cpu()
 
 
