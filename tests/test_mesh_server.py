@@ -103,3 +103,33 @@ def test_mesh_engine_rejects_forward_and_global():
                               n_devices=8)
     with pytest.raises(ValueError):
         MeshAggregationEngine(EngineConfig(is_global=True), n_devices=8)
+
+
+def test_mesh_hot_slot_batch():
+    """A batch overfilling one slot's buffer takes the host pre-cluster
+    sidestep on the mesh path too: exact count/sum/min/max, tail
+    quantiles within 1%."""
+    eng = MeshAggregationEngine(EngineConfig(
+        histogram_slots=64, counter_slots=32, gauge_slots=32,
+        set_slots=16, buffer_depth=64, batch_size=4096,
+        percentiles=(0.5, 0.99),
+        aggregates=("min", "max", "count", "sum")), n_devices=8)
+    eng.warmup()
+    rng = np.random.default_rng(5)
+    hv = rng.gamma(2.0, 20.0, 4096).astype(np.float32)
+    hot = eng.histo_keys.lookup(MetricKey("hot", "timer", ""), 0)
+    cold = eng.histo_keys.lookup(MetricKey("cold", "timer", ""), 0)
+    slots = np.full(4096, hot, np.int32)
+    slots[::8] = cold
+    eng.ingest_histo_batch(slots, hv, np.ones(4096, np.float32))
+    by = {m.name: m.value for m in eng.flush(timestamp=3).metrics}
+    hot_vals = hv[slots == hot].astype(np.float64)
+    assert by["hot.count"] == float(len(hot_vals))
+    assert abs(by["hot.sum"] - hot_vals.sum()) / hot_vals.sum() < 1e-5
+    assert by["hot.min"] == float(hot_vals.min())
+    assert by["hot.max"] == float(hot_vals.max())
+    for q in (0.5, 0.99):
+        exp = float(np.quantile(hot_vals, q))
+        got = by[f"hot.{q*100:g}percentile"]
+        assert abs(got - exp) / exp < 0.01, (q, got, exp)
+    assert by["cold.count"] == float((slots == cold).sum())

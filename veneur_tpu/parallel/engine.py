@@ -115,6 +115,64 @@ class MeshAggregationEngine(AggregationEngine):
         return out
 
     def _add_histos(self, slots, values, weights):
+        # Hot-slot sidestep, mesh flavor: a batch overfilling one slot's
+        # buffer would loop full-shard sorts inside the SPMD ingest
+        # program. Pre-cluster hot slots on host to <= B weighted points
+        # (k1-spaced, with the true min/max kept as singletons so the
+        # exact extremes survive) and push them through the SAME routed
+        # ingest as ordinary weighted samples — sum/count are exactly
+        # preserved by the weights; only recip/hmean degrades to the
+        # digest's own approximation class for the hot batch.
+        slots = np.asarray(slots)
+        B = self.cfg.buffer_depth
+        valid = slots >= 0
+        uniq, cnt = (np.unique(slots[valid], return_counts=True)
+                     if valid.any() else (np.array([]), np.array([])))
+        if cnt.size and cnt.max() > B:
+            values = np.asarray(values, np.float32)
+            weights = np.asarray(weights, np.float32)
+            hot = uniq[cnt > B]
+            # compact the cold rows first: cold + (<= B points per hot
+            # slot, each of which had > B raw samples) always fits the
+            # original batch width, so nothing can truncate below
+            cold_m = valid & ~np.isin(slots, hot)
+            out_s = [slots[cold_m].astype(np.int32)]
+            out_v, out_w = [values[cold_m]], [weights[cold_m]]
+            for s in hot.tolist():
+                m = (slots == s) & valid
+                v = values[m].astype(np.float64)
+                w = weights[m].astype(np.float64)
+                order = np.argsort(v, kind="stable")
+                v, w = v[order], w[order]
+                nb = B - 2
+                qi = (np.sin(np.pi * np.arange(nb + 1) / nb
+                             - np.pi / 2) + 1.0) / 2.0
+                edges = np.unique(
+                    np.floor(1 + qi * (len(v) - 2)).astype(np.int64))
+                edges = edges[(edges >= 1) & (edges < len(v) - 1)]
+                wsum = np.add.reduceat(w[1:-1],
+                                       np.maximum(edges - 1, 0))
+                vsum = np.add.reduceat((v * w)[1:-1],
+                                       np.maximum(edges - 1, 0))
+                keep = wsum > 0
+                cm = np.concatenate(
+                    [[v[0]], vsum[keep] / wsum[keep], [v[-1]]])
+                cw = np.concatenate([[w[0]], wsum[keep], [w[-1]]])
+                out_s.append(np.full(len(cm), s, np.int32))
+                out_v.append(cm.astype(np.float32))
+                out_w.append(cw.astype(np.float32))
+            # pad the combined arrays back to the fixed batch width
+            n = self.cfg.batch_size
+            slots = np.full(n, -1, np.int32)
+            values = np.zeros(n, np.float32)
+            weights = np.zeros(n, np.float32)
+            fs = np.concatenate(out_s)
+            fv = np.concatenate(out_v)
+            fw = np.concatenate(out_w)
+            # cold rows + <=B points per hot slot always fit the batch
+            slots[:len(fs)] = fs[:n]
+            values[:len(fs)] = fv[:n]
+            weights[:len(fs)] = fw[:n]
         hs, hv, hw = self._route(
             self.me.histogram_slots // self.S, slots, values, weights)
         self.me.ingest(hs, hv, hw, *self._pads_for("counter", "gauge",
