@@ -50,6 +50,42 @@ from .worker import KeyInterner
 _IMPORT_W_CAP = 4096
 
 
+def _precluster_k1(v, w, n_points, keep_extremes=False):
+    """Sort one hot slot's (value, weight) samples and cluster them into
+    <= n_points weighted points over k1-spaced (tail-dense) bucket edges
+    — the shared core of both engines' hot-slot sidesteps. Weighted sum
+    and count are exactly preserved; with keep_extremes the true min and
+    max survive as singleton points (for paths with no separate exact-
+    stats merge). Returns (means f64[n], weights f64[n])."""
+    order = np.argsort(v, kind="stable")
+    v, w = v[order], w[order]
+    if keep_extremes:
+        if len(v) <= 2 or n_points <= 3:
+            return v, w  # nothing (or no room) to cluster between ends
+        nb = n_points - 2
+        qi = (np.sin(np.pi * np.arange(nb + 1) / nb - np.pi / 2)
+              + 1.0) / 2.0
+        edges = np.unique(
+            np.floor(1 + qi * (len(v) - 2)).astype(np.int64))
+        edges = edges[(edges >= 1) & (edges < len(v) - 1)]
+        if edges.size == 0:
+            edges = np.array([1], np.int64)
+        wsum = np.add.reduceat(w[1:-1], edges - 1)
+        vsum = np.add.reduceat((v * w)[1:-1], edges - 1)
+        keep = wsum > 0
+        return (np.concatenate([[v[0]], vsum[keep] / wsum[keep],
+                                [v[-1]]]),
+                np.concatenate([[w[0]], wsum[keep], [w[-1]]]))
+    nb = max(1, n_points)
+    qi = (np.sin(np.pi * np.arange(nb + 1) / nb - np.pi / 2) + 1.0) / 2.0
+    edges = np.unique(np.floor(qi * len(v)).astype(np.int64))
+    edges = edges[edges < len(v)]
+    wsum = np.add.reduceat(w, edges)
+    vsum = np.add.reduceat(v * w, edges)
+    keep = wsum > 0
+    return vsum[keep] / wsum[keep], wsum[keep]
+
+
 # ---------------- compiled flush programs (shared across engines) --------
 #
 # The flush must be ONE XLA dispatch, not a chain (compress -> quantile ->
@@ -280,6 +316,9 @@ class AggregationEngine:
 
     def __init__(self, config: EngineConfig | None = None):
         self.cfg = config or EngineConfig()
+        if self.cfg.buffer_depth < 8:
+            raise ValueError("buffer_depth must be >= 8 (hot-slot "
+                             "pre-clustering needs usable bucket room)")
         # One ingest thread owns process(); flush() may run from another
         # thread. The lock is the Worker.Flush mutex-swap equivalent:
         # ingest holds it per item; flush holds it ONLY across
@@ -461,25 +500,13 @@ class AggregationEngine:
             m = (slots == s) & valid
             v = values[m].astype(np.float64)
             w = weights[m].astype(np.float64)
-            order = np.argsort(v, kind="stable")
-            v, w = v[order], w[order]
-            # k1-spaced bucket edges (dense at both tails, like the
-            # digest's own scale function) — uniform count buckets
-            # flatten the tail and cost several % at p99
-            qi = (np.sin(np.pi * np.arange(B + 1) / B
-                         - np.pi / 2) + 1.0) / 2.0
-            edges = np.unique(
-                np.floor(qi * len(v)).astype(np.int64))
-            edges = edges[edges < len(v)]
-            wsum = np.add.reduceat(w, edges)
-            vsum = np.add.reduceat(v * w, edges)
-            keep = wsum > 0
-            out_s.append(np.full(keep.sum(), s, np.int32))
-            out_m.append((vsum[keep] / wsum[keep]).astype(np.float32))
-            out_w.append(wsum[keep].astype(np.float32))
+            cm, cw = _precluster_k1(v, w, B)
+            out_s.append(np.full(len(cm), s, np.int32))
+            out_m.append(cm.astype(np.float32))
+            out_w.append(cw.astype(np.float32))
             sc_s.append(s)
-            sc_min.append(v[0])
-            sc_max.append(v[-1])
+            sc_min.append(float(v.min()))
+            sc_max.append(float(v.max()))
             sc_sum.append(float((v * w).sum()))
             sc_cnt.append(float(w.sum()))
             nz = v != 0

@@ -26,7 +26,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from ..models.pipeline import AggregationEngine, EngineConfig
+from ..models.pipeline import (AggregationEngine, EngineConfig,
+                               _precluster_k1)
 from .mesh import MeshEngine, make_mesh
 
 
@@ -140,24 +141,10 @@ class MeshAggregationEngine(AggregationEngine):
             out_v, out_w = [values[cold_m]], [weights[cold_m]]
             for s in hot.tolist():
                 m = (slots == s) & valid
-                v = values[m].astype(np.float64)
-                w = weights[m].astype(np.float64)
-                order = np.argsort(v, kind="stable")
-                v, w = v[order], w[order]
-                nb = B - 2
-                qi = (np.sin(np.pi * np.arange(nb + 1) / nb
-                             - np.pi / 2) + 1.0) / 2.0
-                edges = np.unique(
-                    np.floor(1 + qi * (len(v) - 2)).astype(np.int64))
-                edges = edges[(edges >= 1) & (edges < len(v) - 1)]
-                wsum = np.add.reduceat(w[1:-1],
-                                       np.maximum(edges - 1, 0))
-                vsum = np.add.reduceat((v * w)[1:-1],
-                                       np.maximum(edges - 1, 0))
-                keep = wsum > 0
-                cm = np.concatenate(
-                    [[v[0]], vsum[keep] / wsum[keep], [v[-1]]])
-                cw = np.concatenate([[w[0]], wsum[keep], [w[-1]]])
+                cm, cw = _precluster_k1(
+                    values[m].astype(np.float64),
+                    weights[m].astype(np.float64), B,
+                    keep_extremes=True)
                 out_s.append(np.full(len(cm), s, np.int32))
                 out_v.append(cm.astype(np.float32))
                 out_w.append(cw.astype(np.float32))
