@@ -75,7 +75,12 @@ def main(argv=None) -> int:
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop.set())
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            # not the main thread (embedded/test use): rely on the
+            # caller to stop us instead of signals
+            break
     stop.wait()
     proxy.stop()
     return 0
