@@ -170,6 +170,7 @@ class Server:
         self.queue_drops = 0
         self.spans_received = 0
         self.ssf_errors = 0
+        self.flush_errors = 0
         self._stats_lock = threading.Lock()
         # SSF span pipeline (SpanWorker + SpanSinks)
         self.span_queue: queue.Queue = queue.Queue(
@@ -852,6 +853,8 @@ class Server:
                 self._last_flush_ok = time.monotonic()
             except Exception as e:
                 log.exception("flush failed")
+                with self._stats_lock:
+                    self.flush_errors += 1
                 if self._sentry is not None:
                     self._sentry.capture(e, "flush failed")
 
@@ -915,6 +918,7 @@ class Server:
             drops, self.queue_drops = self.queue_drops, 0
             spans, self.spans_received = self.spans_received, 0
             sserrs, self.ssf_errors = self.ssf_errors, 0
+            flerrs, self.flush_errors = self.flush_errors, 0
         if self.native_bridge is not None:
             # UDP in native mode is counted in the bridge; fold in the
             # per-interval deltas. Drop taxonomy: ring/backpressure
@@ -946,6 +950,7 @@ class Server:
             mk("veneur.ssf.received_total", spans, MetricType.COUNTER),
             mk("veneur.ssf.error_total", sserrs, MetricType.COUNTER),
             mk("veneur.flush.total_duration_ns", dur_ns, MetricType.GAUGE),
+            mk("veneur.flush.error_total", flerrs, MetricType.COUNTER),
         ]
         if eng_stats is not None:
             out += [
