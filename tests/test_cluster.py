@@ -287,3 +287,53 @@ def test_http_proxy_front_distributes_consistently():
     finally:
         front.stop()
         proxy.stop()
+
+
+def test_two_servers_grpc_forward_to_mesh_global():
+    """local Server --forwardrpc--> GLOBAL Server whose engine is
+    sharded over the 8-device mesh: the full multi-chip global tier,
+    end to end over real loopback gRPC."""
+    glob, gsink = _mk_server({"grpc_listen_addresses": ["127.0.0.1:0"],
+                              "tpu_num_devices": 8,
+                              "tpu_histogram_slots": 64,
+                              "tpu_counter_slots": 32,
+                              "tpu_gauge_slots": 32,
+                              "tpu_set_slots": 16})
+    assert type(glob.engines[0]).__name__ == "MeshAggregationEngine"
+    glob.start()
+    try:
+        local, _ = _mk_server({
+            "forward_address": f"127.0.0.1:{glob.grpc_port}",
+            "statsd_listen_addresses": ["udp://127.0.0.1:0"]})
+        local.start()
+        try:
+            port = local.bound_port()
+            c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            rng = np.random.default_rng(6)
+            vals = rng.normal(100, 10, 400)
+            for v in vals:
+                c.sendto(b"mg.lat:%.4f|ms" % v, ("127.0.0.1", port))
+            c.sendto(b"mg.uniq:x|s\nmg.uniq:y|s", ("127.0.0.1", port))
+            c.sendto(b"mg.total:4|c|#veneurglobalonly",
+                     ("127.0.0.1", port))
+            deadline = time.time() + 30
+            names = {}
+            while time.time() < deadline:
+                names = {m.name: m for m in gsink.all_metrics}
+                got = sum(m.value for m in gsink.all_metrics
+                          if m.name == "mg.lat.count")
+                if got >= 400 and "mg.uniq" in names \
+                        and "mg.total" in names:
+                    break
+                time.sleep(0.3)
+            assert "mg.lat.50percentile" in names, sorted(names)
+            assert names["mg.lat.50percentile"].value == pytest.approx(
+                float(np.median(vals)), abs=3.0)
+            assert sum(m.value for m in gsink.all_metrics
+                       if m.name == "mg.lat.count") == 400.0
+            assert names["mg.uniq"].value == pytest.approx(2, abs=0.5)
+            assert names["mg.total"].value == 4.0
+        finally:
+            local.stop()
+    finally:
+        glob.stop()

@@ -97,12 +97,12 @@ def test_mesh_server_end_to_end_udp():
         srv.stop()
 
 
-def test_mesh_engine_rejects_forward_and_global():
+def test_mesh_engine_rejects_forwarding():
+    # a multi-chip pod is a root of the aggregation tree: it accepts
+    # imports (is_global) but never forwards upstream
     with pytest.raises(ValueError):
         MeshAggregationEngine(EngineConfig(forward_enabled=True),
                               n_devices=8)
-    with pytest.raises(ValueError):
-        MeshAggregationEngine(EngineConfig(is_global=True), n_devices=8)
 
 
 def test_mesh_hot_slot_batch():
@@ -133,3 +133,61 @@ def test_mesh_hot_slot_batch():
         got = by[f"hot.{q*100:g}percentile"]
         assert abs(got - exp) / exp < 0.01, (q, got, exp)
     assert by["cold.count"] == float((slots == cold).sum())
+
+
+def test_mesh_global_tier_imports():
+    """The mesh engine as GLOBAL tier: 32 shards' forwarded digests,
+    sets, counters and gauges Combine over the 8-device mesh and flush
+    globally-accurate values (BASELINE configs 4+5 fused)."""
+    eng = MeshAggregationEngine(EngineConfig(
+        histogram_slots=64, counter_slots=32, gauge_slots=32,
+        set_slots=16, buffer_depth=128, batch_size=2048,
+        hll_precision=10, percentiles=(0.5, 0.99),
+        aggregates=("min", "max", "count", "sum", "hmean"),
+        is_global=True), n_devices=8)
+    eng.warmup()
+    rng = np.random.default_rng(9)
+    n_shards, keys = 32, 8
+    all_vals = {k: [] for k in range(keys)}
+    for shard in range(n_shards):
+        for k in range(keys):
+            vals = rng.gamma(2.0, 20.0, 100).astype(np.float64)
+            all_vals[k].append(vals)
+            # a shard forwards its samples as weighted centroids +
+            # exact scalar stats — what a local flush exports
+            eng.import_histogram(
+                MetricKey(f"t.{k}", "timer", ""), vals,
+                np.ones(100), float(vals.min()), float(vals.max()),
+                float(vals.sum()), 100.0, float((1.0 / vals).sum()))
+        eng.import_counter(MetricKey("hits", "counter", ""), 2.5)
+        eng.import_gauge(MetricKey("g", "gauge", ""), float(shard))
+        # each shard saw members [0, 40*(shard%4+1)) of a shared set
+        from veneur_tpu.ops import hll as hll_ops
+        from veneur_tpu.utils import hashing
+        regs = np.zeros(1 << 10, np.uint8)
+        for mem in range(40 * (shard % 4 + 1)):
+            h = hashing.set_member_hash(f"m{mem}")
+            idx, rho = hll_ops.host_hash_to_updates(
+                np.array([h], np.uint64), 10)
+            regs[idx[0]] = max(regs[idx[0]], rho[0])
+        eng.import_set(MetricKey("u", "set", ""), regs)
+
+    by = {m.name: m.value for m in eng.flush(timestamp=4).metrics}
+    for k in range(keys):
+        union = np.concatenate(all_vals[k])
+        assert by[f"t.{k}.count"] == float(len(union))
+        assert abs(by[f"t.{k}.sum"] - union.sum()) / union.sum() < 1e-5
+        # the exact-stats delta correction makes hmean track the
+        # forwarded reciprocal sums, not the centroid approximation
+        hm_exact = len(union) / (1.0 / union).sum()
+        assert abs(by[f"t.{k}.hmean"] - hm_exact) / hm_exact < 1e-4
+        assert by[f"t.{k}.min"] == float(np.float32(union.min()))
+        assert by[f"t.{k}.max"] == float(np.float32(union.max()))
+        for q in (0.5, 0.99):
+            exp = float(np.quantile(union, q))
+            got = by[f"t.{k}.{q*100:g}percentile"]
+            assert abs(got - exp) / exp < 0.015, (k, q, got, exp)
+    assert by["hits"] == 2.5 * n_shards
+    assert by["g"] == float(n_shards - 1)   # last shard's write wins
+    # union of the shards' sets = members [0, 160)
+    assert abs(by["u"] - 160) / 160 < 0.1

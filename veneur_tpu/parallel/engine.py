@@ -14,11 +14,15 @@ Parity: this subsumes the reference's in-process worker sharding
 partitioned over chips instead of goroutines — while the cluster tier
 (forwardrpc over DCN) stays above it, unchanged.
 
-Limitations (explicit, enforced at construction):
-  * no upstream forwarding from a mesh engine (a multi-chip pod IS the
-    global tier for its keys; cross-pod aggregation goes through the
-    cluster tier's importsrv against a single-device global engine);
-  * no Combine/import into a mesh engine yet, for the same reason.
+The mesh engine also serves as the GLOBAL tier (is_global): forwarded
+digests merge through the same routed ingest — centroids are weighted
+samples, and the exact forwarded min/max ride as ZERO-WEIGHT samples
+(they update the extremes scatter but contribute nothing to
+sum/count/recip); forwarded HLL registers union via a dedicated SPMD
+row-merge program; counters/gauges accumulate on host and land through
+the scalar scatter kernels. Only upstream forwarding from a mesh engine
+is rejected (a multi-chip pod is a root of the aggregation tree; pods
+chain via the cluster tier's importsrv).
 """
 
 from __future__ import annotations
@@ -26,6 +30,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from ..ingest.parser import GLOBAL_ONLY
 from ..models.pipeline import (AggregationEngine, EngineConfig,
                                _precluster_k1)
 from .mesh import MeshEngine, make_mesh
@@ -38,11 +43,11 @@ class MeshAggregationEngine(AggregationEngine):
             raise ValueError(
                 "mesh engine cannot forward upstream; point local "
                 "veneurs at this server's import listener instead")
-        if config.is_global:
-            raise ValueError("mesh engine does not accept imports yet; "
-                             "use a single-device global engine")
         self._mesh_cfg = (mesh, n_devices, n_dp)
         self._pad_cache: dict = {}
+        self._import_h_points = 0
+        self._import_h_deltas: dict = {}
+        self._set_rows_chunk = 64
         super().__init__(config)
 
     # ---------------- device setup ----------------
@@ -263,16 +268,195 @@ class MeshAggregationEngine(AggregationEngine):
         return host
 
     def warmup(self):
-        """Compile the SPMD ingest + merged flush before serving."""
+        """Compile the SPMD ingest + merged flush (+ the global tier's
+        register-row merge) before serving."""
         with self.lock:
             self.me.ingest(*self._pads_for("histo", "counter", "gauge",
                                            "set"))
+            if self.cfg.is_global:
+                nrow = self._set_rows_chunk
+                m = 1 << self.cfg.hll_precision
+                self.me.merge_set_rows(
+                    np.full((self.me.D, self.S * nrow), -1, np.int32),
+                    np.zeros((self.me.D, self.S * nrow, m), np.uint8))
         jax.device_get(self.me.flush_device(self.me._fresh_fn()))
         jax.block_until_ready(self.me.banks.histo.mean)
 
-    # import/Combine is not supported on the mesh tier (see module doc)
+    # ---------------- import (global tier Combine path) ----------------
+    # Overrides: the single-device engine merges imports with dedicated
+    # cluster/merge programs; on the mesh everything lands through the
+    # routed SPMD ingest instead (see module docstring).
 
-    def import_histogram(self, *a, **kw):
-        raise RuntimeError("mesh engine does not accept imports")
+    def import_histogram(self, key, means, weights, vmin, vmax,
+                         vsum, count, recip=0.0):
+        with self.lock:
+            slot = self.histo_keys.lookup(key, GLOBAL_ONLY)
+            if slot < 0:
+                return
+            means = np.asarray(means, np.float64)
+            weights = np.asarray(weights, np.float64)
+            # cap at B-2 so item + extreme riders never exceeds B — the
+            # landing batches are scheduled so one slot never overflows
+            # its buffer in a single scatter, keeping the hot-slot
+            # pre-cluster (whose recip is approximate) OFF this path
+            B = self.cfg.buffer_depth - 2
+            if len(means) > B:
+                means, weights = _precluster_k1(means, weights, B)
+            self._import_centroids.append(
+                (slot, means, weights, float(vmin), float(vmax)))
+            self._import_h_points += len(means) + 2
+            # The staged centroids flow through the ingest scatter, so
+            # they CONTRIBUTE approximate vsum/count/recip; accumulate
+            # the exact-minus-staged delta per slot (f64 host math) and
+            # fold it in via merge_histo_scalars — making the flushed
+            # sum/count/hmean match the forwarded exact values, like
+            # the single-device merge_scalars path.
+            # replicate the device's f32 per-term arithmetic so the
+            # delta cancels the staged contribution to rounding level
+            m32 = means.astype(np.float32)
+            w32 = weights.astype(np.float32)
+            staged_sum = float((m32 * w32).astype(np.float64).sum())
+            staged_cnt = float(w32.astype(np.float64).sum())
+            nz = m32 != 0
+            staged_rcp = float((w32[nz] / m32[nz])
+                               .astype(np.float64).sum())
+            d = self._import_h_deltas.setdefault(slot, [0.0, 0.0, 0.0])
+            d[0] += float(vsum) - staged_sum
+            d[1] += float(count) - staged_cnt
+            d[2] += float(recip) - staged_rcp
+            if self._import_h_points >= self.cfg.batch_size:
+                self._flush_import_centroids_locked()
 
-    import_set = import_counter = import_gauge = import_histogram
+    def import_set(self, key, registers):
+        with self.lock:
+            slot = self.set_keys.lookup(key, GLOBAL_ONLY)
+            if slot < 0:
+                return
+            self._import_sets.append(
+                (slot, np.asarray(registers, np.uint8)))
+            if len(self._import_sets) >= self._set_rows_chunk:
+                self._flush_import_sets_locked()
+
+    # import_counter / import_gauge: the base class's host accumulation
+    # works unchanged; only the landing (in _flush_import_scalars) moves
+    # onto the routed scalar kernels.
+
+    def _flush_import_centroids(self):
+        self._flush_import_centroids_locked()
+
+    def _flush_import_centroids_locked(self):
+        if not self._import_centroids:
+            return
+        items, self._import_centroids = self._import_centroids, []
+        self._import_h_points = 0
+        # schedule landing so each slot contributes at most one item
+        # (<= buffer_depth points) per scatter round: the recip scatter
+        # then sees the staged points verbatim and the exact-stats
+        # deltas cancel to rounding level
+        by_slot: dict = {}
+        for item in items:
+            by_slot.setdefault(item[0], []).append(item)
+        while by_slot:
+            round_items = []
+            for slot in list(by_slot):
+                round_items.append(by_slot[slot].pop(0))
+                if not by_slot[slot]:
+                    del by_slot[slot]
+            slots, vals, wts = [], [], []
+            for slot, means, weights, vmin, vmax in round_items:
+                n = len(means) + 2
+                slots.append(np.full(n, slot, np.int32))
+                vals.append(np.concatenate(
+                    [means, [vmin, vmax]]).astype(np.float32))
+                # exact extremes as zero-weight samples: they update
+                # the min/max scatter, add nothing to sum/count/recip
+                wts.append(np.concatenate(
+                    [weights, [0.0, 0.0]]).astype(np.float32))
+            fs = np.concatenate(slots)
+            fv = np.concatenate(vals)
+            fw = np.concatenate(wts)
+            for cs, (cv, cw) in self._batched(fs, fv, fw):
+                self._add_histos(cs, cv, cw)
+        # exact-stats correction deltas (see import_histogram)
+        deltas, self._import_h_deltas = self._import_h_deltas, {}
+        if deltas:
+            dslots = np.fromiter(deltas.keys(), np.int32, len(deltas))
+            arr = np.array(list(deltas.values()), np.float64)
+            per_shard = self.me.histogram_slots // self.S
+            inf = np.float32(np.inf)
+            for cs, (dsum, dcnt, drcp) in self._batched(
+                    dslots, arr[:, 0].astype(np.float32),
+                    arr[:, 1].astype(np.float32),
+                    arr[:, 2].astype(np.float32)):
+                rs, rsum, rcnt, rrcp = self._route(
+                    per_shard, cs, dsum, dcnt, drcp)
+                self.me.merge_histo_scalars(
+                    rs, np.full_like(rsum, inf),
+                    np.full_like(rsum, -inf), rsum, rcnt, rrcp)
+
+    def _flush_import_sets(self):
+        self._flush_import_sets_locked()
+
+    def _flush_import_sets_locked(self):
+        if not self._import_sets:
+            return
+        items, self._import_sets = self._import_sets, []
+        m = 1 << self.cfg.hll_precision
+        per_shard = self.me.set_slots // self.S
+        nrow = self._set_rows_chunk
+        for i in range(0, len(items), nrow):
+            chunk = items[i:i + nrow]
+            slots = np.array([s for s, _ in chunk], np.int32)
+            regs = np.stack([r for _, r in chunk])
+            out_s = np.full((self.me.D, self.S * nrow), -1, np.int32)
+            out_r = np.zeros((self.me.D, self.S * nrow, m), np.uint8)
+            shard = slots // per_shard
+            order = np.argsort(shard, kind="stable")
+            starts = np.searchsorted(shard[order], np.arange(self.S))
+            pos = np.arange(len(order)) - starts[shard[order]]
+            dest = shard[order] * nrow + pos
+            out_s[0, dest] = slots[order] % per_shard
+            out_r[0, dest] = regs[order]
+            self.me.merge_set_rows(out_s, out_r)
+
+    def _batched(self, flat_slots, *flat_cols):
+        """Yield (slots, cols) batch_size-padded chunks of flat
+        per-sample arrays (-1 slot padding) — the shared pad idiom of
+        every import landing path, at the ingest kernels' fixed shape."""
+        n = self.cfg.batch_size
+        for i in range(0, len(flat_slots), n):
+            seg = slice(i, min(len(flat_slots), i + n))
+            m = seg.stop - seg.start
+            cs = np.full(n, -1, np.int32)
+            cs[:m] = flat_slots[seg]
+            cols = []
+            for c in flat_cols:
+                buf = np.zeros(n, c.dtype)
+                buf[:m] = c[seg]
+                cols.append(buf)
+            yield cs, cols
+
+    def _flush_import_scalars(self):
+        if self._import_counter_acc:
+            acc, self._import_counter_acc = self._import_counter_acc, {}
+            slots = np.fromiter(acc.keys(), np.int32, len(acc))
+            vals = np.fromiter(acc.values(), np.float32, len(acc))
+            for cs, (cv,) in self._batched(slots, vals):
+                rs, rv, rw = self._route(
+                    self.me.counter_slots // self.S, cs, cv,
+                    np.ones(len(cs), np.float32))
+                self.me.ingest(*self._pads_for("histo"), rs, rv, rw,
+                               *self._pads_for("gauge", "set"))
+        if self._import_gauge_acc:
+            acc, self._import_gauge_acc = self._import_gauge_acc, {}
+            slots = np.fromiter(acc.keys(), np.int32, len(acc))
+            vals = np.fromiter(acc.values(), np.float32, len(acc))
+            for cs, (cv,) in self._batched(slots, vals):
+                n = len(cs)
+                seqs = np.arange(1, n + 1, dtype=np.int32) \
+                    + self._gauge_seq
+                self._gauge_seq += n
+                gs, gv, gq = self._route(
+                    self.me.gauge_slots // self.S, cs, cv, seqs)
+                self.me.ingest(*self._pads_for("histo", "counter"),
+                               gs, gv, gq, *self._pads_for("set"))

@@ -207,6 +207,75 @@ class MeshEngine:
             self.banks, h_slots, h_vals, h_wts, c_slots, c_vals, c_wts,
             g_slots, g_vals, g_seqs, s_slots, s_idx, s_rho)
 
+    def _build_merge_set_rows(self):
+        """SPMD union of forwarded HLL register rows into the sharded
+        set bank (the global tier's Set.Combine): rows are pre-routed on
+        host into the [D, S*N] segment layout (slot ids shard-local,
+        -1 padding), registers ride as u8[D, S*N, m]."""
+        if self._single:
+            def step(banks, slots, regs):
+                sq = lambda a: a[0]
+                ex = lambda a: a[None]
+                sets = hll.merge_rows(jax.tree.map(sq, banks.sets),
+                                      slots[0], regs[0])
+                return banks._replace(sets=jax.tree.map(ex, sets))
+
+            dev = self.mesh.devices.reshape(-1)[0]
+            sds = jax.sharding.SingleDeviceSharding(dev)
+            out_sh = jax.tree.map(lambda _: sds, self.banks)
+            return jax.jit(step, donate_argnums=(0,), out_shardings=out_sh)
+
+        def local(banks, slots, regs):
+            sq = lambda a: a[0]
+            sets = hll.merge_rows(jax.tree.map(sq, banks.sets),
+                                  slots[0], regs[0])
+            return banks._replace(
+                sets=jax.tree.map(lambda a: a[None], sets))
+
+        shmapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._specs, P("dp", "shard"),
+                      P("dp", "shard", None)),
+            out_specs=self._specs)
+        return jax.jit(shmapped, donate_argnums=(0,))
+
+    def merge_set_rows(self, slots, registers):
+        """slots i32[D, S*N] (shard-local ids, -1 padding), registers
+        u8[D, S*N, m]."""
+        if not hasattr(self, "_merge_set_fn"):
+            self._merge_set_fn = self._build_merge_set_rows()
+        self.banks = self._merge_set_fn(self.banks, slots, registers)
+
+    def _build_merge_histo_scalars(self):
+        """Routed fold of exact per-slot scalar deltas into the t-digest
+        bank's 2Sum pairs (the global tier's exact-stats correction; the
+        min/max args accept +/-inf sentinels to no-op)."""
+        def local_fn(banks, slots, dmin, dmax, dsum, dcnt, drcp):
+            sq = lambda a: a[0]
+            histo = tdigest.merge_scalars.__wrapped__(
+                jax.tree.map(sq, banks.histo), slots[0], dmin[0],
+                dmax[0], dsum[0], dcnt[0], drcp[0])
+            return banks._replace(
+                histo=jax.tree.map(lambda a: a[None], histo))
+
+        if self._single:
+            dev = self.mesh.devices.reshape(-1)[0]
+            sds = jax.sharding.SingleDeviceSharding(dev)
+            out_sh = jax.tree.map(lambda _: sds, self.banks)
+            return jax.jit(local_fn, donate_argnums=(0,),
+                           out_shardings=out_sh)
+        shmapped = jax.shard_map(
+            local_fn, mesh=self.mesh,
+            in_specs=(self._specs,) + (P("dp", "shard"),) * 6,
+            out_specs=self._specs)
+        return jax.jit(shmapped, donate_argnums=(0,))
+
+    def merge_histo_scalars(self, slots, dmin, dmax, dsum, dcnt, drcp):
+        if not hasattr(self, "_merge_hs_fn"):
+            self._merge_hs_fn = self._build_merge_histo_scalars()
+        self.banks = self._merge_hs_fn(self.banks, slots, dmin, dmax,
+                                       dsum, dcnt, drcp)
+
     # -------------- single-device fast paths --------------
 
     def _build_ingest_single(self):
