@@ -279,6 +279,14 @@ class MeshAggregationEngine(AggregationEngine):
                 self.me.merge_set_rows(
                     np.full((self.me.D, self.S * nrow), -1, np.int32),
                     np.zeros((self.me.D, self.S * nrow, m), np.uint8))
+                # the exact-stats delta fold compiles here too, not
+                # under the engine lock at the first forwarded digest
+                shape = (self.me.D, self.S * self.cfg.batch_size)
+                zf = np.zeros(shape, np.float32)
+                self.me.merge_histo_scalars(
+                    np.full(shape, -1, np.int32),
+                    np.full(shape, np.inf, np.float32),
+                    np.full(shape, -np.inf, np.float32), zf, zf, zf)
         jax.device_get(self.me.flush_device(self.me._fresh_fn()))
         jax.block_until_ready(self.me.banks.histo.mean)
 
