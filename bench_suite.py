@@ -31,14 +31,15 @@ import numpy as np
 from veneur_tpu.utils.platform import pin_cpu, tunnel_alive
 
 if os.environ.get("VENEUR_BENCH_CPU", "") not in ("", "0"):
-    # explicit host-only baseline
-    pin_cpu()
+    # explicit host-only baseline (virtual 8-device mesh so the
+    # multi-chip configs exercise real sharding)
+    pin_cpu(8)
 elif not tunnel_alive():
     # dead relay: every backend init would hang in the axon client's
     # connect-retry loop; pin cpu and record real numbers instead
     print(json.dumps({"metric": "tunnel_dead_cpu_fallback", "value": 1,
                       "unit": "bool", "vs_baseline": 0}))
-    pin_cpu()
+    pin_cpu(8)
 
 
 RESULTS: list = []
@@ -375,9 +376,63 @@ def config5_multichip_100k():
           larger_is_better=False)
 
 
+def config7_mesh_global_merge():
+    """The multi-chip GLOBAL tier (mesh Combine): 32 shards' forwarded
+    digests for 512 keys each merged into an engine sharded over all
+    visible devices, then one collective flush. Times the full import
+    landing (route + SPMD scatter + delta fold) and the merged flush."""
+    import jax
+
+    from veneur_tpu.ingest.parser import MetricKey
+    from veneur_tpu.models.pipeline import EngineConfig
+    from veneur_tpu.parallel.engine import MeshAggregationEngine
+
+    D = len(jax.devices())
+    n_shards, keys, per = 32, 512, 128
+    eng = MeshAggregationEngine(EngineConfig(
+        histogram_slots=1024, counter_slots=256, gauge_slots=256,
+        set_slots=64, buffer_depth=256, batch_size=8192,
+        percentiles=(0.5, 0.99), aggregates=("count",),
+        is_global=True), n_devices=D)
+    eng.warmup()
+    rng = np.random.default_rng(0)
+    mkeys = [MetricKey(f"t.{k}", "timer", "") for k in range(keys)]
+    shard_payloads = []
+    for _ in range(n_shards):
+        vals = rng.gamma(2, 20, (keys, per)).astype(np.float64)
+        shard_payloads.append(vals)
+    wts = np.ones(per)
+
+    t0 = time.perf_counter()
+    for vals in shard_payloads:
+        sums = vals.sum(axis=1)
+        mins = vals.min(axis=1)
+        maxs = vals.max(axis=1)
+        recips = (1.0 / vals).sum(axis=1)
+        for k in range(keys):
+            eng.import_histogram(mkeys[k], vals[k], wts,
+                                 float(mins[k]), float(maxs[k]),
+                                 float(sums[k]), float(per),
+                                 float(recips[k]))
+    res = eng.flush(timestamp=1)
+    n = len(res.metrics)
+    dt_ms = (time.perf_counter() - t0) * 1000
+    _emit(f"c7_mesh_global_merge_32shards_ms_{D}dev", dt_ms, "ms",
+          50.0, larger_is_better=False, platform=_platform())
+    exact = np.concatenate([p[0] for p in shard_payloads])
+    by = {m.name: m.value for m in res.metrics}
+    err = abs(by["t.0.99percentile"]
+              - float(np.quantile(exact, 0.99))) / float(
+                  np.quantile(exact, 0.99))
+    _emit("c7_mesh_global_p99_rel_err", err, "ratio", 0.01,
+          larger_is_better=False)
+    assert by["t.0.count"] == float(n_shards * per), by["t.0.count"]
+
+
 CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            3: config3_sets_1m_uniques, 4: config4_forward_merge_32_shards,
-           5: config5_multichip_100k, 6: config6_e2e_udp_ingest}
+           5: config5_multichip_100k, 6: config6_e2e_udp_ingest,
+           7: config7_mesh_global_merge}
 
 
 def main():
