@@ -170,3 +170,19 @@ def test_example_yaml_is_complete_and_loads():
     cfg = config_mod.read_config(path)
     assert cfg.interval_seconds == 10.0
     assert cfg.tpu_compression == 100.0
+
+
+def test_config_validation_rejects_nonsense():
+    with pytest.raises(ValueError):
+        read_config(text="percentiles: [1.5]")
+    with pytest.raises(ValueError):
+        read_config(text="percentiles: [0]")
+    with pytest.raises(ValueError):
+        read_config(text="interval: 0s")
+    with pytest.raises(ValueError):
+        read_config(text="tpu_buffer_depth: 2")
+    with pytest.raises(ValueError):
+        read_config(text="tpu_hll_precision: 31")
+    # lenient like the reference: unknown aggregates warn, don't fail
+    cfg = read_config(text="aggregates: ['count', 'p9999']")
+    assert cfg.aggregates == ["count", "p9999"]

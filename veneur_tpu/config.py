@@ -167,7 +167,37 @@ def read_config(path: str | None = None, text: str | None = None,
         ev = env.get("VENEUR_" + name.upper())
         if ev is not None:
             setattr(cfg, name, _coerce(name, ev))
+    _validate(cfg)
     return cfg
+
+
+_KNOWN_AGGREGATES = {"min", "max", "sum", "avg", "count", "median",
+                     "hmean"}
+
+
+def _validate(cfg: Config) -> None:
+    """Reject configs that would fail obscurely later (bad percentiles
+    clip silently in the quantile kernel; zero intervals spin the flush
+    loop). Unknown aggregates warn, like veneur's lenient parsing."""
+    for p in cfg.percentiles:
+        if not (0.0 < float(p) < 1.0):
+            raise ValueError(
+                f"percentile {p} out of range (0, 1) exclusive")
+    if cfg.interval_seconds <= 0:
+        raise ValueError(f"interval must be positive: {cfg.interval!r}")
+    unknown = [a for a in cfg.aggregates
+               if a not in _KNOWN_AGGREGATES]
+    if unknown:
+        log.warning("unknown aggregates %r ignored (known: %s)",
+                    unknown, sorted(_KNOWN_AGGREGATES))
+    for key in ("tpu_histogram_slots", "tpu_counter_slots",
+                "tpu_gauge_slots", "tpu_set_slots", "tpu_batch_size"):
+        if getattr(cfg, key) <= 0:
+            raise ValueError(f"{key} must be positive")
+    if cfg.tpu_buffer_depth < 8:
+        raise ValueError("tpu_buffer_depth must be >= 8")
+    if not (4 <= cfg.tpu_hll_precision <= 16):
+        raise ValueError("tpu_hll_precision must be in [4, 16]")
 
 
 def _coerce(name: str, v):
