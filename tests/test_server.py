@@ -183,6 +183,13 @@ def test_config_validation_rejects_nonsense():
         read_config(text="tpu_buffer_depth: 2")
     with pytest.raises(ValueError):
         read_config(text="tpu_hll_precision: 31")
+    with pytest.raises(ValueError):      # no :port — clear error at load,
+        read_config(text="stats_address: localhost")   # not at bind time
+    with pytest.raises(ValueError):
+        read_config(text="stats_address: 'host:notaport'")
+    assert read_config(
+        text="stats_address: '127.0.0.1:8125'"
+    ).stats_address == "127.0.0.1:8125"
     # lenient like the reference: unknown aggregates warn, don't fail
     cfg = read_config(text="aggregates: ['count', 'p9999']")
     assert cfg.aggregates == ["count", "p9999"]

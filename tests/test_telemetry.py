@@ -207,3 +207,54 @@ def test_sentry_client(http_capture):
     exc = ev["exception"]["values"][0]
     assert exc["type"] == "RuntimeError" and exc["value"] == "boom"
     assert exc["stacktrace"]["frames"]
+
+
+def test_slow_sink_does_not_delay_flush_tick():
+    """A wedged vendor must not push the next tick late: the flusher
+    never joins sink threads; a sink whose previous flush is still in
+    flight skips the interval (counted as
+    veneur.sink.flush_skipped_total) while healthy sinks keep flushing
+    (flusher.go's independent per-sink goroutines)."""
+    from veneur_tpu.sinks import MetricSink
+
+    class WedgedSink(MetricSink):
+        def __init__(self):
+            self.release = threading.Event()
+            self.calls = 0
+
+        def name(self):
+            return "wedged"
+
+        def flush(self, metrics):
+            pass
+
+        def flush_frames(self, frames):
+            self.calls += 1
+            self.release.wait(20.0)
+            return 0
+
+    slow = WedgedSink()
+    cap = CaptureMetricSink()
+    cfg = Config(interval="3600s", hostname="h",
+                 tpu_histogram_slots=256, tpu_counter_slots=128,
+                 tpu_gauge_slots=128, tpu_set_slots=64)
+    srv = Server(cfg, sinks=[slow, cap], plugins=[], span_sinks=[])
+    srv.start()
+    try:
+        # pre-fix this blocked for cfg.interval (3600s) joining the
+        # wedged sink's thread; now it must return promptly
+        srv.flush_once(timestamp=1)
+        cap.wait_for_flush(1)
+        srv.flush_once(timestamp=2)   # wedged still in flight -> skip
+        cap.wait_for_flush(2)
+        assert slow.calls == 1        # skipped, not re-entered
+        srv.flush_once(timestamp=3)   # reports flush 2's skip counter
+        cap.wait_for_flush(3)
+        names = {(m.name, tuple(m.tags)) for m in cap.flushes[2]}
+        assert ("veneur.sink.flush_skipped_total",
+                ("sink:wedged",)) in names
+        # the healthy sink saw every interval
+        assert len(cap.flushes) == 3
+    finally:
+        slow.release.set()
+        srv.stop()

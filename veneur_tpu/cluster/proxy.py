@@ -222,6 +222,10 @@ class HttpProxyFront:
         self._server = None
         self.proxied_total = 0
         self.errors_total = 0
+        # handle_batch runs on ThreadingHTTPServer handler threads (one
+        # per POST); the counter read-modify-writes need a lock even
+        # after the per-batch results are aggregated post-join.
+        self._totals_lock = threading.Lock()
 
     def route_json(self, dicts: list) -> dict[str, list]:
         groups: dict[str, list] = {}
@@ -236,11 +240,12 @@ class HttpProxyFront:
 
     def handle_batch(self, dicts: list) -> list:
         groups = self.route_json(dicts)
-        errs: list = []
-        failed = [0]
+        # per-thread result slots, aggregated after the join; the shared
+        # totals are then bumped under _totals_lock (concurrent POSTs)
+        results: list = [None] * len(groups)
         threads = []
-        for dest, ms in groups.items():
-            def send(dest=dest, ms=ms):
+        for i, (dest, ms) in enumerate(groups.items()):
+            def send(i=i, dest=dest, ms=ms):
                 try:
                     fw = self._dests.get(dest)
                     if fw is None:
@@ -249,15 +254,17 @@ class HttpProxyFront:
                 except Exception as e:
                     log.warning("http proxy forward to %s failed: %s",
                                 dest, e)
-                    errs.append(e)
-                    failed[0] += len(ms)
+                    results[i] = (e, len(ms))
             t = threading.Thread(target=send, daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
             t.join()
-        self.proxied_total += len(dicts) - failed[0]
-        self.errors_total += len(errs)
+        errs = [r[0] for r in results if r is not None]
+        failed = sum(r[1] for r in results if r is not None)
+        with self._totals_lock:
+            self.proxied_total += len(dicts) - failed
+            self.errors_total += len(errs)
         return errs
 
     def start(self, address: str):

@@ -198,6 +198,16 @@ def _validate(cfg: Config) -> None:
         raise ValueError("tpu_buffer_depth must be >= 8")
     if not (4 <= cfg.tpu_hll_precision <= 16):
         raise ValueError("tpu_hll_precision must be in [4, 16]")
+    if cfg.stats_address:
+        host, sep, port = cfg.stats_address.rpartition(":")
+        if (not sep or not port.isdigit()
+                or not (0 < int(port) < 65536)
+                or (":" in host
+                    and not (host.startswith("[")
+                             and host.endswith("]")))):
+            raise ValueError(
+                f"stats_address must be host:port (IPv6 hosts "
+                f"bracketed), got {cfg.stats_address!r}")
 
 
 def _coerce(name: str, v):

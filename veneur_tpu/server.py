@@ -155,6 +155,12 @@ class Server:
         # per-sink flush stats from the previous interval
         self._sink_stats: dict[str, tuple[int, float]] = {}
         self._sink_stats_lock = threading.Lock()
+        # in-flight fan-out threads (flusher-thread-only) + skip counts:
+        # a sink whose previous flush is still running skips the interval
+        # instead of delaying the tick (flusher.go's per-sink goroutines
+        # never block the ticker).
+        self._sink_inflight: dict[tuple, threading.Thread] = {}
+        self._sink_skips: dict[tuple, int] = {}   # (kind, name) -> n
 
         self._threads: list[threading.Thread] = []
         self._sockets: list[socket.socket] = []
@@ -406,6 +412,13 @@ class Server:
             self.native_pump.stop()
         if self.native_bridge is not None:
             self.native_bridge.stop()
+        # the fan-out never joins sink threads; drain them here (bounded)
+        # so the final interval's data isn't killed mid-POST at exit and
+        # sinks aren't stop()ed under an in-flight flush
+        deadline = time.monotonic() + min(
+            10.0, self.cfg.interval_seconds)
+        for t in list(self._sink_inflight.values()):
+            t.join(max(0.0, deadline - time.monotonic()))
         for s in self.sinks + self.span_sinks:
             try:
                 s.stop()
@@ -874,8 +887,32 @@ class Server:
             status_metrics = []
             eng_stats = {"samples": 0, "dropped_no_slot": 0,
                          "swap_ns": 0, "merge_ns": 0, "assembly_ns": 0}
-            for eng in self.engines:
-                res = eng.flush(timestamp=ts)
+            # Engines flush concurrently so their device→host transfers
+            # overlap: on the tunneled backend each device_get pays a
+            # ~65-90ms wire floor, and N engines in sequence pay it N
+            # times; in parallel they pay ~1×. Single engine = no thread.
+            results: list = [None] * len(self.engines)
+            if len(self.engines) == 1:
+                results[0] = self.engines[0].flush(timestamp=ts)
+            else:
+                def _one(i, eng):
+                    try:
+                        results[i] = eng.flush(timestamp=ts)
+                    except BaseException as e:
+                        results[i] = e
+                ths = [threading.Thread(target=_one, args=(i, eng),
+                                        daemon=True,
+                                        name=f"engine-flush-{i}")
+                       for i, eng in enumerate(self.engines)]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+            for eng, res in zip(self.engines, results):
+                if isinstance(res, BaseException):
+                    raise res
+                if res is None:   # a flush thread died; surface it
+                    raise RuntimeError("engine flush failed")
                 for k in eng_stats:
                     eng_stats[k] += res.stats.get(k, 0)
                 frames.append(res.frame)
@@ -970,6 +1007,7 @@ class Server:
         # per-sink flush spans / sink.flushed_metrics self-metrics.
         with self._sink_stats_lock:
             sink_stats, self._sink_stats = self._sink_stats, {}
+            sink_skips, self._sink_skips = self._sink_skips, {}
         for name, (count, ns, errs) in sorted(sink_stats.items()):
             tags = [f"sink:{name}"]
             out.append(mk("veneur.sink.metrics_flushed_total", count,
@@ -978,6 +1016,11 @@ class Server:
                           MetricType.GAUGE, tags))
             out.append(mk("veneur.sink.flush_errors_total", errs,
                           MetricType.COUNTER, tags))
+        for (kind, name), skips in sorted(sink_skips.items()):
+            # tagged by component kind so a wedged plugin named like a
+            # sink doesn't masquerade as that sink in the skip counter
+            out.append(mk("veneur.sink.flush_skipped_total", skips,
+                          MetricType.COUNTER, [f"{kind}:{name}"]))
         if self._stats_sock is not None:
             # scopedstatsd mode: ship veneur.* over the wire to
             # stats_address (usually this server's own statsd port)
@@ -996,17 +1039,33 @@ class Server:
         return out
 
     def _fan_out(self, frameset, events, checks):
-        """Per-sink parallel flush with timeout isolation (one goroutine
-        per sink in Server.Flush). Sinks receive the columnar FrameSet;
-        legacy sinks materialize InterMetrics lazily in their own thread
-        (cached once, shared), frame-native sinks never do."""
-        threads = []
+        """Per-sink parallel flush, decoupled from the tick (one
+        independent goroutine per sink in Server.Flush — the flusher
+        NEVER joins them). Sinks receive the columnar FrameSet; legacy
+        sinks materialize InterMetrics lazily in their own thread
+        (cached once, shared), frame-native sinks never do. A sink whose
+        previous flush is still in flight skips this interval — counted
+        as veneur.sink.flush_skipped_total — so one wedged vendor can't
+        push the next tick late or starve the other sinks."""
+        def spawn(key, target):
+            prev = self._sink_inflight.get(key)
+            if prev is not None and prev.is_alive():
+                with self._sink_stats_lock:
+                    self._sink_skips[key] = (
+                        self._sink_skips.get(key, 0) + 1)
+                return
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"{key[0]}-{key[1]}")
+            self._sink_inflight[key] = t
+            t.start()
+
         for s in self.sinks:
             def run(sink=s):
                 t0 = time.monotonic()
                 ok = False
+                n = None
                 try:
-                    sink.flush_frames(frameset)
+                    n = sink.flush_frames(frameset)
                     if events or checks:
                         sink.flush_other(events, checks)
                     ok = True
@@ -1016,26 +1075,24 @@ class Server:
                     # reported in the NEXT interval's veneur.sink.*
                     # self-metrics (flusher.go per-sink spans); a failed
                     # flush reports 0 flushed + an error count, so a
-                    # down vendor is visible, not masked
+                    # down vendor is visible, not masked. flush_frames
+                    # returns the count actually serialized (after sink
+                    # routing / STATUS drops); None = everything.
+                    count = 0
+                    if ok:
+                        count = n if isinstance(n, int) else len(frameset)
                     with self._sink_stats_lock:
                         self._sink_stats[sink.name()] = (
-                            len(frameset) if ok else 0,
-                            (time.monotonic() - t0) * 1e9,
+                            count, (time.monotonic() - t0) * 1e9,
                             0 if ok else 1)
-            t = threading.Thread(target=run, daemon=True,
-                                 name=f"sink-{s.name()}")
-            t.start()
-            threads.append(t)
+            spawn(("sink", s.name()), run)
         for p in self.plugins:
             def runp(plugin=p):
                 try:
                     plugin.flush_frames(frameset, self.hostname)
                 except Exception:
                     log.exception("plugin %s flush failed", plugin.name())
-            t = threading.Thread(target=runp, daemon=True,
-                                 name=f"plugin-{p.name()}")
-            t.start()
-            threads.append(t)
+            spawn(("plugin", p.name()), runp)
         for ss in self.span_sinks:
             def runs(sink=ss):
                 try:
@@ -1043,13 +1100,7 @@ class Server:
                 except Exception:
                     log.exception("span sink %s flush failed",
                                   sink.name())
-            t = threading.Thread(target=runs, daemon=True,
-                                 name=f"spansink-{ss.name()}")
-            t.start()
-            threads.append(t)
-        deadline = time.monotonic() + self.cfg.interval_seconds
-        for t in threads:
-            t.join(max(0.0, deadline - time.monotonic()))
+            spawn(("spansink", ss.name()), runs)
 
     def _start_profiling(self):
         """enable_profiling: expose the JAX/XLA profiler (xprof) — the

@@ -28,13 +28,19 @@ class MetricSink(abc.ABC):
     def flush(self, metrics: list[InterMetric]) -> None:
         """Deliver one interval's metrics. Called once per flush tick."""
 
-    def flush_frames(self, frames: FrameSet) -> None:
+    def flush_frames(self, frames: FrameSet) -> int | None:
         """Frame-aware delivery: the server hands every sink the flush's
         columnar FrameSet. The default materializes InterMetrics (lazily,
         in this sink's thread, shared across legacy sinks) and calls
         flush(); frame-native sinks override this to serialize straight
-        from the blocks and never build 600k Python objects."""
-        self.flush(filter_for_sink(self.name(), frames.to_list()))
+        from the blocks and never build 600k Python objects.
+
+        Returns the number of metrics actually delivered (after sink
+        routing / type drops) so veneur.sink.metrics_flushed_total counts
+        what went out, not what was offered; None means "all of them"."""
+        routed = filter_for_sink(self.name(), frames.to_list())
+        self.flush(routed)
+        return len(routed)
 
     def flush_other(self, events, checks) -> None:
         """Deliver events / service checks (FlushOtherSamples)."""

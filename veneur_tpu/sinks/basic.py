@@ -29,7 +29,9 @@ class BlackholeMetricSink(MetricSink):
 
     def flush_frames(self, frames):
         # frame-native: count without materializing a single InterMetric
-        self.flushed_total += len(frames)
+        n = len(frames)
+        self.flushed_total += n
+        return n
 
 
 class BlackholeSpanSink(SpanSink):

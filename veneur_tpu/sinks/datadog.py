@@ -95,13 +95,14 @@ class DatadogMetricSink(MetricSink):
 
     def _split_tags(self, tg: list) -> tuple:
         """(host_override, device, merged_tags) for one key's shared tag
-        list. Memoized by identity: tag lists are interned per key in the
-        engine's presentation cache and shared across flushes, so the
-        host:/device: scan runs once per key, not once per metric. The
-        memo holds a reference to the list, keeping the id stable."""
-        memo = self._tag_memo.get(id(tg))
-        if memo is not None and memo[0] is tg:
-            return memo[1]
+        list. Memoized by value (tuple of the tags) so ephemeral lists —
+        per-flush self-metrics, extras — share entries with the interned
+        frame lists instead of growing the memo per list instance; the
+        bound keeps worst-case retention to ~64k entries."""
+        key = tuple(tg)
+        out = self._tag_memo.get(key)
+        if out is not None:
+            return out
         host, device, tags = "", "", list(self.tags)
         for t in tg:
             if t.startswith("host:"):
@@ -110,10 +111,10 @@ class DatadogMetricSink(MetricSink):
                 device = t[7:]
             else:
                 tags.append(t)
-        if len(self._tag_memo) > 1_000_000:
+        if len(self._tag_memo) >= 65536:
             self._tag_memo.clear()
         out = (host, device, tags)
-        self._tag_memo[id(tg)] = (tg, out)
+        self._tag_memo[key] = out
         return out
 
     def flush_frames(self, frames):
@@ -159,6 +160,7 @@ class DatadogMetricSink(MetricSink):
                     app(self._series(x))
         self._post_series(series)
         self._post_status(checks)
+        return len(series) + len(checks)
 
     def _post_status(self, status_metrics):
         """Status-typed InterMetrics (the StatusCheck sampler's flush
