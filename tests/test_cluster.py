@@ -284,6 +284,20 @@ def test_http_proxy_front_distributes_consistently():
         except urllib.error.HTTPError as e:
             assert e.code == 400
         assert front.proxied_total == 600
+        # declared unknown forward format -> 400 (jsonmetric-v1
+        # contract), declared v1 accepted
+        for ver, want in (("gob", 400), ("jsonmetric-v1", 200)):
+            req_v = urllib.request.Request(
+                f"http://127.0.0.1:{port}/import",
+                data=_json.dumps(batch[:3]).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Veneur-Forward-Version": ver},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req_v, timeout=5) as resp:
+                    assert resp.status == want
+            except urllib.error.HTTPError as e:
+                assert e.code == want
     finally:
         front.stop()
         proxy.stop()

@@ -118,6 +118,34 @@ def test_http_import_bad_body():
         srv.stop()
 
 
+def test_http_import_rejects_unknown_forward_version():
+    """jsonmetric-v1 contract: a DECLARED format we don't speak is a
+    400, not a misparse; the client sends the version header."""
+    from veneur_tpu.cluster.forward import HttpJsonForwarder
+    assert HttpJsonForwarder.FORMAT == "jsonmetric-v1"
+    srv, _ = make_server(http_address="127.0.0.1:0", is_global=True)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.http_api.port}"
+        req = urllib.request.Request(
+            f"{base}/import", data=b"[]",
+            headers={"Content-Type": "application/json",
+                     "X-Veneur-Forward-Version": "gob"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+        # declared v1 (what HttpJsonForwarder sends) is accepted
+        req = urllib.request.Request(
+            f"{base}/import", data=b"[]",
+            headers={"Content-Type": "application/json",
+                     "X-Veneur-Forward-Version": "jsonmetric-v1"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+    finally:
+        srv.stop()
+
+
 def test_import_counter_and_set_roundtrip():
     glob, gsink = make_server(http_address="127.0.0.1:0", is_global=True,
                               interval="60s")

@@ -56,7 +56,19 @@ class GrpcForwarder:
 class HttpJsonForwarder:
     """Legacy-path forwarder: POST /import with a JSON array (the
     reference's JSONMetric list; digests ride as centroid arrays rather
-    than Go gob blobs)."""
+    than Go gob blobs).
+
+    This is a VERSIONED CONTRACT, not a stopgap: the body is the
+    `jsonmetric-v1` format (see README § HTTP forward contract), declared
+    on the wire via the X-Veneur-Forward-Version header so a receiver
+    can reject a format it does not speak instead of misparsing it.
+    The reference's gob-encoded `[]JSONMetric` body (flusher.go sym:
+    flushForward) is deliberately NOT emitted — gob is a Go-internal
+    reflection format and both ends of this path are ours; mixed fleets
+    interoperate over the gRPC metricpb path, which stays
+    byte-compatible (tests/test_wire_golden.py)."""
+
+    FORMAT = "jsonmetric-v1"
 
     def __init__(self, base_url: str, timeout_s: float = 10.0):
         self.url = base_url.rstrip("/") + "/import"
@@ -89,7 +101,9 @@ class HttpJsonForwarder:
                          "value": value})
         req = urllib.request.Request(
             self.url, data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers={"Content-Type": "application/json",
+                     "X-Veneur-Forward-Version": self.FORMAT},
+            method="POST")
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             if resp.status >= 400:
                 raise RuntimeError(f"forward POST: HTTP {resp.status}")

@@ -202,7 +202,9 @@ class _JsonDest:
         import urllib.request
         req = urllib.request.Request(
             self.url, data=_json.dumps(dicts).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers={"Content-Type": "application/json",
+                     "X-Veneur-Forward-Version": "jsonmetric-v1"},
+            method="POST")
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             if resp.status >= 400:
                 raise RuntimeError(f"proxy POST: HTTP {resp.status}")
@@ -291,6 +293,15 @@ class HttpProxyFront:
                 if self.path.rstrip("/") != "/import":
                     self.send_response(404)
                     self.end_headers()
+                    return
+                # jsonmetric-v1 contract (README § HTTP forward
+                # contract): reject a declared format we don't speak
+                ver = self.headers.get("X-Veneur-Forward-Version")
+                if ver is not None and ver != "jsonmetric-v1":
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(
+                        f"unsupported forward format {ver!r}\n".encode())
                     return
                 n = int(self.headers.get("Content-Length", 0))
                 try:

@@ -198,6 +198,16 @@ def _validate(cfg: Config) -> None:
         raise ValueError("tpu_buffer_depth must be >= 8")
     if not (4 <= cfg.tpu_hll_precision <= 16):
         raise ValueError("tpu_hll_precision must be in [4, 16]")
+    # t-digest centroid capacity is ~2*compression (fixed 100), padded to
+    # 128 lanes. A buffer shallower than that makes the global import
+    # path pay ceil(C/B) compress dispatches per landing round —
+    # quadratic-ish for tiny buffers. Legal, but worth a loud warning.
+    if cfg.tpu_buffer_depth < 256:
+        log.warning(
+            "tpu_buffer_depth=%d is below the t-digest centroid "
+            "capacity (256): forwarded-digest imports will pay %d "
+            "compress dispatches per landing round instead of 1",
+            cfg.tpu_buffer_depth, -(-256 // cfg.tpu_buffer_depth))
     if cfg.stats_address:
         host, sep, port = cfg.stats_address.rpartition(":")
         if (not sep or not port.isdigit()

@@ -119,6 +119,14 @@ class HttpApi:
                 if api._submit is None:
                     self._reply(503, b"not a global veneur\n")
                     return
+                # jsonmetric-v1 contract: reject a declared format we
+                # don't speak rather than misparse it; absent header =
+                # v1 (curl/operator tooling)
+                ver = self.headers.get("X-Veneur-Forward-Version")
+                if ver is not None and ver != "jsonmetric-v1":
+                    self._reply(400, f"unsupported forward format "
+                                     f"{ver!r}\n".encode())
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n))

@@ -418,7 +418,14 @@ class Server:
         deadline = time.monotonic() + min(
             10.0, self.cfg.interval_seconds)
         for t in list(self._sink_inflight.values()):
-            t.join(max(0.0, deadline - time.monotonic()))
+            while True:
+                try:
+                    t.join(max(0.0, deadline - time.monotonic()))
+                    break
+                except RuntimeError:   # registered but not yet started
+                    if time.monotonic() >= deadline:
+                        break
+                    time.sleep(0.005)
         for s in self.sinks + self.span_sinks:
             try:
                 s.stop()
@@ -1056,6 +1063,8 @@ class Server:
                 return
             t = threading.Thread(target=target, daemon=True,
                                  name=f"{key[0]}-{key[1]}")
+            # register BEFORE start so stop()'s drain can never miss an
+            # in-flight sink; stop() tolerates the not-yet-started window
             self._sink_inflight[key] = t
             t.start()
 
