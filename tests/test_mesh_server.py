@@ -191,3 +191,70 @@ def test_mesh_global_tier_imports():
     assert by["g"] == float(n_shards - 1)   # last shard's write wins
     # union of the shards' sets = members [0, 160)
     assert abs(by["u"] - 160) / 160 < 0.1
+
+
+def test_mesh_global_tier_adversarial_landing():
+    """The global tier's exact-stats delta correction (engine.py
+    host-replicates the device's f32 per-term arithmetic so the deltas
+    cancel) must not depend on landing order, chunk boundaries, or
+    interleaving with live ingest. Forwarded digests of random odd
+    sizes land in a shuffled order, import rounds are cut at random
+    points, and live samples for the SAME keys arrive in between —
+    count stays exact, sum near-exact, hmean within tolerance."""
+    from veneur_tpu.ingest import parser
+
+    eng = MeshAggregationEngine(EngineConfig(
+        histogram_slots=64, counter_slots=32, gauge_slots=32,
+        set_slots=16, buffer_depth=128, batch_size=2048,
+        percentiles=(0.5, 0.99),
+        aggregates=("min", "max", "count", "sum", "hmean"),
+        is_global=True), n_devices=8)
+    eng.warmup()
+    rng = np.random.default_rng(17)
+    keys, n_shards = 6, 12
+    expected = {k: [] for k in range(keys)}
+    jobs = []
+    for _ in range(n_shards):
+        for k in range(keys):
+            n = int(rng.integers(3, 160))    # odd sizes straddle chunks
+            vals = rng.gamma(2.0, 20.0, n).astype(np.float64)
+            jobs.append((k, vals))
+            expected[k].append(vals)
+    live = []
+    for k in range(keys):
+        n = int(rng.integers(5, 60))
+        vals = np.round(rng.gamma(2.0, 20.0, n), 4)
+        live.append((k, vals))
+        expected[k].append(vals.astype(np.float64))
+    rng.shuffle(jobs)
+    li = 0
+    for k, vals in jobs:
+        eng.import_histogram(
+            MetricKey(f"t.{k}", "timer", ""), vals, np.ones(len(vals)),
+            float(vals.min()), float(vals.max()), float(vals.sum()),
+            float(len(vals)), float((1.0 / vals).sum()))
+        if rng.random() < 0.2:               # random chunk boundary
+            eng._flush_import_centroids()
+        if li < len(live) and rng.random() < 0.2:
+            k2, lv = live[li]
+            li += 1
+            for x in lv:
+                eng.process(parser.parse_packet(
+                    f"t.{k2}:{x:.4f}|ms".encode()))
+    for k2, lv in live[li:]:
+        for x in lv:
+            eng.process(parser.parse_packet(f"t.{k2}:{x:.4f}|ms".encode()))
+
+    by = {m.name: m.value for m in eng.flush(timestamp=5).metrics}
+    for k in range(keys):
+        union = np.concatenate(expected[k])
+        assert by[f"t.{k}.count"] == float(len(union)), k
+        assert abs(by[f"t.{k}.sum"] - union.sum()) / union.sum() < 1e-5
+        hm = len(union) / (1.0 / union).sum()
+        assert abs(by[f"t.{k}.hmean"] - hm) / hm < 1e-3, (k, hm)
+        assert by[f"t.{k}.min"] == float(np.float32(union.min()))
+        assert by[f"t.{k}.max"] == float(np.float32(union.max()))
+        for q in (0.5, 0.99):
+            exp = float(np.quantile(union, q))
+            got = by[f"t.{k}.{q*100:g}percentile"]
+            assert abs(got - exp) / exp < 0.02, (k, q, got, exp)

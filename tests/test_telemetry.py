@@ -209,6 +209,35 @@ def test_sentry_client(http_capture):
     assert exc["stacktrace"]["frames"]
 
 
+def test_multi_engine_flush_overlaps():
+    """Engines flush concurrently: on the tunneled TPU backend each
+    engine's device_get pays a ~65-90ms wire floor, so N sequential
+    flushes cost N floors. flush_once must overlap them — with four
+    0.3s fake engines the tick takes ~1 floor, not ~4."""
+    from veneur_tpu.models.pipeline import FlushResult
+
+    from veneur_tpu.metrics import MetricFrame
+
+    class FakeEngine:
+        def flush(self, timestamp=None):
+            time.sleep(0.3)
+            return FlushResult(frame=MetricFrame(timestamp=1),
+                               stats={"samples": 1})
+
+        def drain_events(self):
+            return [], []
+
+    cfg = Config(interval="3600s", hostname="h",
+                 tpu_histogram_slots=256, tpu_counter_slots=128,
+                 tpu_gauge_slots=128, tpu_set_slots=64)
+    srv = Server(cfg, sinks=[], plugins=[], span_sinks=[])
+    srv.engines = [FakeEngine() for _ in range(4)]
+    t0 = time.monotonic()
+    srv.flush_once(timestamp=1)
+    dt = time.monotonic() - t0
+    assert dt < 0.9, f"4x0.3s engine flushes took {dt:.2f}s (not overlapped)"
+
+
 def test_slow_sink_does_not_delay_flush_tick():
     """A wedged vendor must not push the next tick late: the flusher
     never joins sink threads; a sink whose previous flush is still in
