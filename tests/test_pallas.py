@@ -55,3 +55,33 @@ def test_estimate_via_pallas_stats_matches_jnp_estimate():
     np.testing.assert_allclose(np.asarray(est_pallas),
                                np.asarray(est_jnp), rtol=1e-5)
     assert float(est_pallas[3]) == 0.0   # empty slot stays 0
+
+
+def test_pallas_stats_inside_shard_map():
+    """The mesh flush places the Pallas kernel INSIDE shard_map (device-
+    local block compute after the dp register union). Validate the
+    pattern on the CPU mesh via interpret mode: per-shard hll_stats
+    under shard_map must match the whole-array jnp reduction."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    rng = np.random.default_rng(4)
+    regs = rng.integers(0, 25, (16, 512)).astype(np.uint8)
+    regs[5] = 0
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("shard",))
+
+    def local_stats(r):
+        ez, zsum = hll_stats(r, interpret=True)
+        return ez, zsum
+
+    # check_vma=False like the product merge_fn: pallas_call outputs
+    # can't declare their varying mesh axes
+    f = jax.jit(jax.shard_map(
+        local_stats, mesh=mesh, in_specs=(P("shard", None),),
+        out_specs=(P("shard"), P("shard")), check_vma=False))
+    ez, zsum = f(regs)
+    ez_ref = (regs == 0).sum(axis=1).astype(np.float32)
+    zsum_ref = np.exp2(-regs.astype(np.float64)).sum(axis=1)
+    np.testing.assert_array_equal(np.asarray(ez), ez_ref)
+    np.testing.assert_allclose(np.asarray(zsum), zsum_ref, rtol=1e-5)

@@ -103,6 +103,18 @@ def _use_pallas() -> bool:
         return False
 
 
+def will_use_pallas(num_registers: int) -> bool:
+    """True when estimate() will take the Pallas kernel for banks of
+    this register width. Exposed so mesh program builders can PLACE the
+    estimate consistently with this choice: the Pallas kernel belongs
+    inside shard_map (device-local block compute, the recommended
+    pallas-under-shard_map pattern), while the jnp estimator belongs in
+    the plain-jit epilogue (its reductions hit the documented slow
+    lowering inside manually-partitioned regions — see
+    parallel/mesh.py:_build_flush)."""
+    return _use_pallas() and num_registers % 512 == 0
+
+
 def estimate(bank: HLLBank, force_jnp: bool = False) -> jax.Array:
     """Batched cardinality estimate, one f32 per slot.
 
@@ -110,10 +122,11 @@ def estimate(bank: HLLBank, force_jnp: bool = False) -> jax.Array:
     with beta a degree-7 polynomial in ln(ez + 1). Valid across the whole
     range (no linear-counting switchover needed).
 
-    `force_jnp` pins the pure-jnp path — for callers tracing this inside
-    shard_map/pjit programs where the Pallas kernel isn't validated.
+    `force_jnp` pins the pure-jnp path for callers that manage kernel
+    placement themselves (the engine's fused flush builds separate
+    executables per choice).
     """
-    if not force_jnp and _use_pallas() and bank.num_registers % 512 == 0:
+    if not force_jnp and will_use_pallas(bank.num_registers):
         return _estimate_pallas(bank)
     return _estimate_jnp(bank)
 

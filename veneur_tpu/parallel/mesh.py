@@ -318,7 +318,7 @@ class MeshEngine:
             sb = jax.tree.map(sq, banks.sets)
             q = tdigest.quantile(hb, qs)
             agg = tdigest.aggregates(hb)
-            est = hll.estimate(sb, force_jnp=True)
+            est = hll.estimate(sb)   # picks Pallas on TPU, jnp elsewhere
             pairs = (hb.count, hb.count_lo, hb.vsum, hb.vsum_lo)
             return (q, agg, cb.hi, cb.lo, gb.seq,
                     jnp.where(gb.seq >= 0, gb.value, -jnp.inf), est,
@@ -333,17 +333,32 @@ class MeshEngine:
 
         1. shard_map MERGE — everything that needs the "dp" collectives
            (all_gather of centroids, psum/pmin/pmax of scalars, register
-           union). Outputs are the dp-merged, shard-sharded banks.
-        2. plain-jit EPILOGUE — quantile/aggregates/estimate over the
-           merged state. These are slot-parallel with no cross-shard
-           dependence, so XLA's automatic partitioning handles the
-           sharded inputs; keeping them OUT of shard_map matters because
-           several of their op compositions (sort feeding masked
-           reductions, closed-over scalar indexing) lower to a
-           pathologically slow path inside manually-partitioned regions
-           (~1000x on the TPU backend this was profiled on).
+           union), plus the Pallas HLL estimate when the kernel is in
+           play (hll.will_use_pallas): a Pallas call is opaque
+           device-local block compute — immune to the partitioner slow
+           path below — and the post-pmax registers are exactly its
+           per-device block shape.
+        2. plain-jit EPILOGUE — quantile/aggregates (and the jnp HLL
+           estimate when Pallas is NOT in play) over the merged state.
+           These are slot-parallel with no cross-shard dependence, so
+           XLA's automatic partitioning handles the sharded inputs;
+           keeping them OUT of shard_map matters because several of
+           their op compositions (sort feeding masked reductions,
+           closed-over scalar indexing, the jnp estimator's masked
+           reductions) lower to a pathologically slow path inside
+           manually-partitioned regions (~1000x on the TPU backend this
+           was profiled on).
         """
         comp = self.compression
+        # Estimate PLACEMENT follows the kernel choice (hll.will_use_
+        # pallas): the Pallas kernel runs inside the shard_map — after
+        # the dp pmax union the registers are shard-local [s_local, R],
+        # exactly the per-device block the kernel is written for — while
+        # the jnp estimator stays in the plain-jit epilogue, because its
+        # reductions hit the slow manually-partitioned lowering this
+        # docstring describes. CPU meshes and VENEUR_TPU_NO_PALLAS=1
+        # therefore keep the old epilogue path bit-for-bit.
+        pallas_ok = hll.will_use_pallas(1 << self.hll_precision)
 
         def merge(histo, counter, gauge, sets):
             sq = lambda a: a[0]
@@ -382,7 +397,11 @@ class MeshEngine:
                 jnp.where((gb.seq == g_seq) & (g_seq >= 0), gb.value,
                           -jnp.inf), "dp")
             regs = jax.lax.pmax(sb.registers.astype(jnp.int32), "dp")
-            return merged, c_hi, c_lo, g_seq, g_val, regs
+            if pallas_ok:   # kernel on the local block; else raw regs
+                out = hll.estimate(hll.HLLBank(regs.astype(jnp.uint8)))
+            else:           # jnp estimate runs in the epilogue
+                out = regs
+            return merged, c_hi, c_lo, g_seq, g_val, out
 
         bank_spec = TDigestBank(
             mean=P("shard", None), weight=P("shard", None),
@@ -392,7 +411,8 @@ class MeshEngine:
             vsum_lo=P("shard"), count_lo=P("shard"),
             recip_lo=P("shard"))
         out_specs = (bank_spec, P("shard"), P("shard"), P("shard"),
-                     P("shard"), P("shard", None))
+                     P("shard"),
+                     P("shard") if pallas_ok else P("shard", None))
         # check_vma=False: outputs ARE dp-replicated (they come from
         # all_gather/psum/pmax over "dp"), but the varying-axes inference
         # can't prove it for all_gather-derived values.
@@ -402,18 +422,21 @@ class MeshEngine:
             check_vma=False))
 
         @jax.jit
-        def epilogue(merged, regs, qs):
+        def epilogue(merged, est_or_regs, qs):
             q = tdigest.quantile(merged, qs)
             agg = tdigest.aggregates(merged)
-            est = hll.estimate(hll.HLLBank(regs.astype(jnp.uint8)),
-                               force_jnp=True)
+            if pallas_ok:
+                est = est_or_regs          # computed in the shard_map
+            else:
+                est = hll.estimate(hll.HLLBank(
+                    est_or_regs.astype(jnp.uint8)), force_jnp=True)
             pairs = (merged.count, merged.count_lo,
                      merged.vsum, merged.vsum_lo)
             return q, agg, est, pairs
 
         def flush(banks):
-            merged, c_hi, c_lo, g_seq, g_val, regs = merge_fn(*banks)
-            q, agg, est, pairs = epilogue(merged, regs, self.qs)
+            merged, c_hi, c_lo, g_seq, g_val, eor = merge_fn(*banks)
+            q, agg, est, pairs = epilogue(merged, eor, self.qs)
             return q, agg, c_hi, c_lo, g_seq, g_val, est, pairs
 
         return flush
