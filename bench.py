@@ -303,11 +303,13 @@ def main() -> int:
         return TOTAL_BUDGET_S - (time.monotonic() - t_start)
 
     platform = "auto"
+    relay_dead = False
     from veneur_tpu.utils.platform import tunnel_alive
     if not tunnel_alive():
         _log("axon relay ports refused — tunnel dead; pinning cpu "
              "for the whole budget")
         platform = "cpu"
+        relay_dead = True
     # Phase 1: small K — proves the platform works and warms nothing
     # shared (workers are separate processes), cheap on any backend.
     r_small = _run_worker(10_000, min(remaining() - 60.0, 150.0), platform)
@@ -341,6 +343,10 @@ def main() -> int:
             "unit": "ms",
             "vs_baseline": 0.0,
         }
+    if relay_dead:
+        # record WHY this artifact is a CPU fallback: the TPU relay was
+        # down at bench time (probe evidence in TUNNEL_PROBE_r*.jsonl)
+        result["relay_dead"] = True
     print(json.dumps(result), flush=True)
     return 0
 

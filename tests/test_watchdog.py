@@ -1,0 +1,42 @@
+"""The flush watchdog's crash-only exit (Server.FlushWatchdog parity:
+panic after watchdog_max_ticks → supervisor restart).
+
+os._exit(2) kills the interpreter, so the test drives a real Server in
+a subprocess: flushes are wedged, the watchdog must take the process
+down with exit code 2 within a few intervals.
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import threading, time
+from veneur_tpu.config import Config
+from veneur_tpu.server import Server
+
+cfg = Config(interval="0.2s", hostname="wd",
+             flush_watchdog_missed_flushes=3,
+             tpu_histogram_slots=64, tpu_counter_slots=32,
+             tpu_gauge_slots=32, tpu_set_slots=16)
+srv = Server(cfg, sinks=[], plugins=[], span_sinks=[])
+# wedge every flush BEFORE the loop starts
+srv.flush_once = lambda *a, **k: time.sleep(3600)
+srv.start()
+print("started", flush=True)
+time.sleep(30)   # the watchdog must kill us long before this
+raise SystemExit(7)  # reaching here = watchdog failed
+"""
+
+
+def test_watchdog_exits_process_when_flushes_stall():
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT], cwd=_REPO,
+        capture_output=True, timeout=120, text=True)
+    assert "started" in proc.stdout
+    assert proc.returncode == 2, (proc.returncode, proc.stderr[-800:])
+    assert "flush watchdog" in proc.stderr
