@@ -39,8 +39,18 @@ def _log(msg: str) -> None:
 
 # ---------------------------------------------------------------- worker
 
-def worker(k: int, budget_s: float, platform: str) -> int:
-    """Run the flush-merge bench at cardinality k; print one JSON line."""
+def worker(k: int, budget_s: float, platform: str,
+           fetch_mode: str = "probe") -> int:
+    """Run the flush-merge bench at cardinality k; print one JSON line.
+
+    `fetch_mode` is an engine flush_fetch mode for the e2e phase, or
+    "probe" to measure every mode and pick the best (the 10k worker
+    probes; the orchestrator passes the winner to the 100k worker).
+    Exec and fetch are timed SEPARATELY: on the tunneled backend a
+    synchronous fetch invalidates the loaded executable and the next
+    dispatch pays a full recompile (TPU_EVIDENCE_r04.md §2), so an
+    alternating dispatch+fetch loop measures the relay, not the program.
+    """
     deadline = time.monotonic() + budget_s
     import numpy as np
 
@@ -134,17 +144,36 @@ def worker(k: int, budget_s: float, platform: str) -> int:
     compile_s = time.monotonic() - t0
     _log(f"worker: compile+first-run {compile_s:.1f}s")
 
-    times, fetches = [], []
+    # Steady-state EXEC-ONLY loop: no interleaved fetch, so the relay
+    # can't invalidate the executable between dispatches — this is the
+    # program's true on-device latency. The first post-fetch dispatch
+    # still carries the warmup fetch's poison, so it's measured but
+    # reported separately.
+    post_fetch_ms, _ = run_prog(bank, fetch=False)
+    times = []
     for i in range(MAX_TIMED_ITERS):
         if times and time.monotonic() >= deadline:
             _log(f"worker: deadline hit after {len(times)} iters")
             break
-        exec_ms, fetch_ms = run_prog(bank, fetch=True)
+        exec_ms, _ = run_prog(bank, fetch=False)
         times.append(exec_ms)
-        fetches.append(fetch_ms)
     times.sort()
-    fetches.sort()
     p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+    _log(f"worker: exec-only p99 {p99:.2f}ms over {len(times)} iters "
+         f"(first post-fetch dispatch: {post_fetch_ms:.1f}ms)")
+
+    # Fetch cost, measured on 3 dispatch+fetch rounds (each fetch poisons
+    # the NEXT dispatch — visible in the exec column, kept out of the
+    # fetch medians).
+    fetches = []
+    for i in range(3):
+        if fetches and time.monotonic() >= deadline:
+            break
+        e_ms, f_ms = run_prog(bank, fetch=True)
+        fetches.append(f_ms)
+        _log(f"worker: fetch round {i}: exec {e_ms:.1f}ms "
+             f"fetch {f_ms:.1f}ms")
+    fetches.sort()
     fetch_med = fetches[len(fetches) // 2]
 
     # Transport probe: the device->host wire rate for a FRESH array of
@@ -168,9 +197,55 @@ def worker(k: int, budget_s: float, platform: str) -> int:
          f"{payload_mb:.1f} MB payload; program fetch median "
          f"{fetch_med:.1f}ms")
 
+    # ---- fetch-mode probe: replicate the engine's _flush_device per
+    # mode and pick the cheapest dispatch+fetch round trip. Each mode's
+    # first round inherits the previous mode's poison, so the MEDIAN of
+    # 3 reflects the mode's own steady state.
+    mode_table = {}
+    best_mode = fetch_mode if fetch_mode != "probe" else "sync"
+    if fetch_mode == "probe":
+        sds = jax.sharding.SingleDeviceSharding(dev)
+
+        def make_stage(sharding):
+            s = jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t),
+                        out_shardings=sharding)
+            jax.device_get(s(jnp.zeros(8, jnp.float32)))  # probe support
+            return s
+
+        stages = {"sync": None, "async": None}
+        try:
+            stages["staged"] = make_stage(sds)
+            stages["host"] = make_stage(jax.sharding.SingleDeviceSharding(
+                dev, memory_kind="pinned_host"))
+        except Exception as exc:
+            _log(f"worker: mode probe: {exc!r}")
+        for mode, stage in stages.items():
+            if time.monotonic() >= deadline - 5.0:
+                break
+            rounds = []
+            for i in range(3):
+                copy = jax.tree_util.tree_map(jnp.copy, (bank,) + small)
+                jax.block_until_ready(copy)
+                t0 = time.monotonic()
+                out = prog(*copy, qs)
+                if stage is not None:
+                    out = stage(out)
+                elif mode == "async":
+                    for leaf in jax.tree_util.tree_leaves(out):
+                        leaf.copy_to_host_async()
+                jax.device_get(out)
+                rounds.append((time.monotonic() - t0) * 1000.0)
+            rounds.sort()
+            mode_table[mode] = round(rounds[len(rounds) // 2], 1)
+            _log(f"worker: mode {mode}: median {mode_table[mode]:.1f}ms "
+                 f"rounds {[f'{r:.0f}' for r in rounds]}")
+        if mode_table:
+            best_mode = min(mode_table, key=mode_table.get)
+        _log(f"worker: best fetch mode: {best_mode}")
+
     # ---- end-to-end phase: the same worst-case bank through the real
-    # engine flush (lock+swap, merge program, device_get, columnar
-    # InterMetric assembly for k interned keys) — VERDICT r1 item 2.
+    # engine flush (lock+swap, merge program, fetch under the chosen
+    # mode, columnar InterMetric assembly for k interned keys).
     e2e = {}
     if time.monotonic() < deadline - 2.5 * (times[0] / 1000.0) - 10.0:
         from veneur_tpu.ingest.parser import MetricKey
@@ -178,7 +253,7 @@ def worker(k: int, budget_s: float, platform: str) -> int:
             AggregationEngine, EngineConfig)
         eng = AggregationEngine(EngineConfig(
             histogram_slots=k, counter_slots=16, gauge_slots=16,
-            set_slots=16, buffer_depth=BUF))
+            set_slots=16, buffer_depth=BUF, flush_fetch=best_mode))
         eng.warmup()  # what Server.start() does before its flush loop
         for i in range(k):
             eng.histo_keys.lookup(
@@ -230,8 +305,9 @@ def worker(k: int, budget_s: float, platform: str) -> int:
             "e2e_materialize_ms": round(stats["materialize_ms"], 2),
             "e2e_sink_frame_ms": round(stats["sink_frame_ms"], 2),
             # transport accounting: merge_ns = program exec + the
-            # device->host fetch; exec is `value`, so the residual is
-            # wire time, cross-checked against the measured probe rate
+            # device->host fetch; exec_p99_ms is the program-only cost,
+            # so the residual over it is wire time, cross-checked
+            # against the measured probe rate
             "fetch_mb": round(payload_mb, 2),
             "probe_mbps": round(probe_mbps, 1),
             "transport_floor_ms": round(
@@ -240,27 +316,44 @@ def worker(k: int, budget_s: float, platform: str) -> int:
                 e2e_p99 - payload_mb / probe_mbps * 1000.0, 1),
         }
 
-    # vs_baseline is only meaningful at the north-star cardinality (100k);
-    # a 10k fallback result must not claim to beat the 100k target.
-    vs = round(TARGET_MS / p99, 3) if k >= 100_000 else 0.0
-    print(json.dumps({
+    # Headline value: the served-engine e2e p99 when measured, else the
+    # program's exec-only p99. vs_baseline is only meaningful at the
+    # north-star cardinality (100k). On the tunneled rig the e2e number
+    # carries the wire floor (transport_floor_ms) that directly-attached
+    # hardware would not pay — vs_baseline_ex_transport is the target
+    # ratio with the MEASURED wire floor subtracted, exec_p99_ms is the
+    # pure program latency.
+    headline = e2e.get("e2e_p99_ms", p99)
+    vs = round(TARGET_MS / headline, 3) if k >= 100_000 else 0.0
+    out_rec = {
         "metric": f"flush_merge_p99_ms_{k // 1000}k_histos_{plat}",
-        "value": round(p99, 3),
+        "value": round(headline, 3),
         "unit": "ms",
         "vs_baseline": vs,
         "k": k,
         "platform": plat,
-        "iters": len(times),
+        "exec_p99_ms": round(p99, 3),
+        "exec_iters": len(times),
+        "post_fetch_dispatch_ms": round(post_fetch_ms, 1),
         "compile_s": round(compile_s, 1),
         "prog_fetch_med_ms": round(fetch_med, 1),
+        "fetch_mode": best_mode,
         **e2e,
-    }), flush=True)
+    }
+    if mode_table:
+        out_rec["fetch_mode_table_ms"] = mode_table
+        out_rec["best_fetch_mode"] = best_mode
+    if k >= 100_000 and "e2e_minus_transport_ms" in e2e:
+        out_rec["vs_baseline_ex_transport"] = round(
+            TARGET_MS / max(e2e["e2e_minus_transport_ms"], p99, 1e-3), 3)
+    print(json.dumps(out_rec), flush=True)
     return 0
 
 
 # ----------------------------------------------------------- orchestrator
 
-def _run_worker(k: int, timeout_s: float, platform: str):
+def _run_worker(k: int, timeout_s: float, platform: str,
+                fetch_mode: str = "probe"):
     if timeout_s < 40.0:
         _log(f"worker k={k} platform={platform}: skipped "
              f"(only {timeout_s:.0f}s left)")
@@ -269,7 +362,7 @@ def _run_worker(k: int, timeout_s: float, platform: str):
     # deadline logic can salvage a partial result.
     worker_budget = max(timeout_s - 20.0, 20.0)
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
-           str(k), str(worker_budget), platform]
+           str(k), str(worker_budget), platform, fetch_mode]
     _log(f"spawn worker k={k} platform={platform} timeout={timeout_s:.0f}s")
     try:
         p = subprocess.run(
@@ -326,14 +419,29 @@ def main() -> int:
     # that a hang here can still fall back to a CPU-pinned attempt; on a
     # tight budget give the (proven-working) default platform everything
     # rather than silently rerouting the north-star metric to CPU.
+    # The 10k worker probed every fetch mode; hand the winner to the
+    # 100k worker — but only for the same platform (a mode probed on the
+    # tunneled TPU says nothing about CPU, where plain sync is right:
+    # there is no fetch-side invalidation to work around).
+    mode = (r_small or {}).get("best_fetch_mode", "probe")
+    small_plat = (r_small or {}).get("platform", "")
+
+    def mode_for(target_platform: str) -> str:
+        if target_platform == "cpu" or small_plat == "cpu":
+            return "sync" if target_platform == "cpu" else "probe"
+        return mode
+
     r_big = None
     if remaining() > 60.0:
         if platform == "auto" and remaining() >= 160.0:
-            r_big = _run_worker(100_000, remaining() - 100.0, platform)
+            r_big = _run_worker(100_000, remaining() - 100.0, platform,
+                                mode_for("auto"))
             if r_big is None:
-                r_big = _run_worker(100_000, remaining() - 10.0, "cpu")
+                r_big = _run_worker(100_000, remaining() - 10.0, "cpu",
+                                    mode_for("cpu"))
         else:
-            r_big = _run_worker(100_000, remaining() - 15.0, platform)
+            r_big = _run_worker(100_000, remaining() - 15.0, platform,
+                                mode_for(platform))
 
     result = r_big or r_small
     if result is None:
@@ -353,5 +461,6 @@ def main() -> int:
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
-        sys.exit(worker(int(sys.argv[2]), float(sys.argv[3]), sys.argv[4]))
+        sys.exit(worker(int(sys.argv[2]), float(sys.argv[3]), sys.argv[4],
+                        sys.argv[5] if len(sys.argv) > 5 else "probe"))
     sys.exit(main())

@@ -289,3 +289,26 @@ def test_hot_slot_batch_accuracy_and_count():
     for q in (0.5,):
         exp = float(np.quantile(cv.astype(np.float64), q))
         assert abs(by[f"cold.{q*100:g}percentile"] - exp) / exp < 0.02
+
+
+@pytest.mark.parametrize("mode", ["sync", "staged", "host", "async"])
+def test_flush_fetch_modes_identical(mode):
+    """Every flush_fetch mode must produce identical results (the modes
+    only change HOW outputs leave the device — TPU_EVIDENCE_r04.md §4).
+    "host" falls back to "staged" where pinned_host is unsupported."""
+    lines = [b"c.hits:7|c", b"g.temp:70|g", b"s.u:alice|s", b"s.u:bob|s"]
+    lines += [f"t.req:{v}|ms".encode() for v in range(1, 201)]
+
+    ref_eng = AggregationEngine(small_config())
+    feed(ref_eng, lines)
+    ref = {(m.name, tuple(m.tags)): m.value
+           for m in ref_eng.flush(1000).metrics}
+
+    eng = AggregationEngine(small_config(flush_fetch=mode))
+    eng.warmup()
+    feed(eng, lines)
+    got = {(m.name, tuple(m.tags)): m.value
+           for m in eng.flush(1000).metrics}
+    assert got.keys() == ref.keys()
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-6, err_msg=k)

@@ -121,6 +121,10 @@ class Config:
     tpu_hll_precision: int = 14
     tpu_slot_idle_ttl_intervals: int = 16
     tpu_num_devices: int = 0           # 0 = all visible devices
+    # Flush-result fetch strategy: "sync" | "staged" | "host" | "async".
+    # Non-sync modes work around relayed backends where a synchronous
+    # device_get invalidates the serving executable (TPU_EVIDENCE_r04.md).
+    tpu_flush_fetch: str = "sync"
 
     # --- native C++ ingest bridge (native/vtpu_ingest.cpp) ---
     # When on, UDP DogStatsD ingest (readers + parse + key interning +
@@ -198,6 +202,9 @@ def _validate(cfg: Config) -> None:
         raise ValueError("tpu_buffer_depth must be >= 8")
     if not (4 <= cfg.tpu_hll_precision <= 16):
         raise ValueError("tpu_hll_precision must be in [4, 16]")
+    if cfg.tpu_flush_fetch not in ("sync", "staged", "host", "async"):
+        raise ValueError(
+            "tpu_flush_fetch must be one of sync/staged/host/async")
     # t-digest centroid capacity is ~2*compression (fixed 100), padded to
     # 128 lanes. A buffer shallower than that makes the global import
     # path pay ceil(C/B) compress dispatches per landing round —

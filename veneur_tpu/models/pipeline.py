@@ -28,6 +28,7 @@ Scope routing (flusher.go semantics):
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 import time
 from dataclasses import dataclass, field as dc_field
@@ -42,6 +43,8 @@ from ..metrics import InterMetric, MetricFrame, MetricType
 from ..ops import hll, scalar, tdigest
 from ..utils import hashing
 from .worker import KeyInterner
+
+logger = logging.getLogger(__name__)
 
 
 # Widest per-slot centroid pile the import path will hand to one device
@@ -232,6 +235,20 @@ class EngineConfig:
     forward_enabled: bool = False
     is_global: bool = False      # global tier: emit percentiles for imports
     hostname: str = ""
+    # How flush results leave the device. "sync" is one device_get (the
+    # production pattern on directly-attached TPUs). The alternatives
+    # exist for relayed/tunneled backends where a synchronous fetch of an
+    # executable's outputs invalidates its loaded state and the NEXT
+    # dispatch pays a full recompile (~6.7s @100k slots, measured — see
+    # TPU_EVIDENCE_r04.md §2):
+    #   "staged" — a tiny jitted copy program re-materializes the outputs
+    #              and the fetch targets ITS outputs, so only the cheap
+    #              staging executable is invalidated;
+    #   "host"   — the staging copy writes to pinned_host memory, putting
+    #              the D2H transfer inside the program (falls back to
+    #              "staged" when the backend lacks host memory kinds);
+    #   "async"  — copy_to_host_async on every leaf before the gather.
+    flush_fetch: str = "sync"
 
 
 @dataclass
@@ -322,6 +339,29 @@ class AggregationEngine:
             self._device, cfg.compression, self._fwd_out,
             tuple(self._agg_emit),
             self._device.platform in ("tpu", "axon"))
+        self._stage_exec = None
+        mode = cfg.flush_fetch
+        if mode in ("staged", "host"):
+            def make_stage(sharding):
+                return jax.jit(
+                    lambda t: jax.tree_util.tree_map(jnp.copy, t),
+                    out_shardings=sharding)
+
+            if mode == "host":
+                # pinned_host support only shows up at compile/run time
+                # (CPU constructs the sharding fine, then fails with "no
+                # registered implementation ... for Host") — probe it.
+                try:
+                    stage = make_stage(jax.sharding.SingleDeviceSharding(
+                        self._device, memory_kind="pinned_host"))
+                    jax.device_get(stage(jnp.zeros(8, jnp.float32)))
+                    self._stage_exec = stage
+                except Exception:
+                    logger.warning("flush_fetch=host: backend lacks "
+                                   "pinned_host memory; using staged")
+            if self._stage_exec is None:
+                self._stage_exec = make_stage(
+                    jax.sharding.SingleDeviceSharding(self._device))
 
     def __init__(self, config: EngineConfig | None = None):
         self.cfg = config or EngineConfig()
@@ -653,8 +693,9 @@ class AggregationEngine:
             self.histo_bank = self._kern["merge_scalars"](
                 self.histo_bank, np.full(swidth, -1, np.int32),
                 sz, sz, sz, sz, sz)
-        hb, cb, gb, sb = self._fresh_fn()
-        jax.device_get(self._flush_exec(hb, cb, gb, sb, self._qs))
+        # Run the full configured flush path (program + staging/fetch
+        # mode) so flush 0 hits only warm executables.
+        self._flush_device(self._fresh_fn())
         jax.block_until_ready(self.histo_bank.mean)
 
     # ---------------- import (global tier Combine path) ----------------
@@ -853,9 +894,17 @@ class AggregationEngine:
         """Run the fused flush program on the snapshot and fetch the
         compact host arrays: ONE program dispatch + ONE device_get (on a
         tunneled TPU backend the transfer IS the flush cost; the program
-        itself is ~3ms at 100k slots). Overridden by the mesh engine."""
+        itself is ~0.2ms at 100k slots, TPU_EVIDENCE_r04.md §1).
+        `flush_fetch` picks how the fetch is performed (see EngineConfig).
+        Overridden by the mesh engine."""
         hb, cb, gb, sb = snap
-        return jax.device_get(self._flush_exec(hb, cb, gb, sb, self._qs))
+        out = self._flush_exec(hb, cb, gb, sb, self._qs)
+        if self._stage_exec is not None:
+            out = self._stage_exec(out)
+        elif self.cfg.flush_fetch == "async":
+            for leaf in jax.tree_util.tree_leaves(out):
+                leaf.copy_to_host_async()
+        return jax.device_get(out)
 
     def flush(self, timestamp: int | None = None) -> FlushResult:
         """The Server.Flush equivalent: snapshot banks, run the merge

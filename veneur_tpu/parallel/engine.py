@@ -27,13 +27,18 @@ chain via the cluster tier's importsrv).
 
 from __future__ import annotations
 
+import logging
+
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ingest.parser import GLOBAL_ONLY
 from ..models.pipeline import (AggregationEngine, EngineConfig,
                                _precluster_k1)
 from .mesh import MeshEngine, make_mesh
+
+logger = logging.getLogger(__name__)
 
 
 class MeshAggregationEngine(AggregationEngine):
@@ -81,6 +86,28 @@ class MeshAggregationEngine(AggregationEngine):
         # the MeshEngine owns the compiled flush; the single-device
         # _flush_executable is never built for a mesh engine
         self._flush_exec = None
+        self._stage_exec = None
+        mode = self.cfg.flush_fetch
+        if mode in ("staged", "host"):
+            if mode == "host":
+                logger.warning("flush_fetch=host is not supported on the "
+                               "mesh engine; using staged")
+            # No out_shardings: outputs keep the mesh flush program's
+            # shardings — the point is that the fetch targets THIS cheap
+            # executable's outputs, so a relayed backend's fetch-side
+            # invalidation (TPU_EVIDENCE_r04.md §2) re-uploads the tiny
+            # copy program, not the collective merge.
+            self._stage_exec = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t))
+
+    def _fetch_flush(self, out):
+        """device_get under the configured flush_fetch mode."""
+        if self._stage_exec is not None:
+            out = self._stage_exec(out)
+        elif self.cfg.flush_fetch == "async":
+            for leaf in jax.tree_util.tree_leaves(out):
+                leaf.copy_to_host_async()
+        return jax.device_get(out)
 
     # ---------------- ingest ----------------
     # Staged batches carry GLOBAL slot ids straight from the interners;
@@ -243,7 +270,7 @@ class MeshAggregationEngine(AggregationEngine):
     def _flush_device(self, snap) -> dict:
         """Collective merge over the mesh, mapped onto the host-dict
         contract the shared assembly consumes."""
-        dev = jax.device_get(self.me.flush_device(snap))
+        dev = self._fetch_flush(self.me.flush_device(snap))
         agg = dev["agg"]
         host = {
             "q": dev["quantiles"],
@@ -287,7 +314,7 @@ class MeshAggregationEngine(AggregationEngine):
                     np.full(shape, -1, np.int32),
                     np.full(shape, np.inf, np.float32),
                     np.full(shape, -np.inf, np.float32), zf, zf, zf)
-        jax.device_get(self.me.flush_device(self.me._fresh_fn()))
+        self._fetch_flush(self.me.flush_device(self.me._fresh_fn()))
         jax.block_until_ready(self.me.banks.histo.mean)
 
     # ---------------- import (global tier Combine path) ----------------

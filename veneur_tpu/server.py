@@ -66,6 +66,7 @@ class Server:
             percentiles=tuple(cfg.percentiles),
             aggregates=tuple(cfg.aggregates),
             idle_ttl_intervals=cfg.tpu_slot_idle_ttl_intervals,
+            flush_fetch=cfg.tpu_flush_fetch,
             forward_enabled=bool(cfg.forward_address
                                  or cfg.consul_forward_service_name),
             # a server with a gRPC import listener is (also) a global tier
