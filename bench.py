@@ -162,6 +162,52 @@ def worker(k: int, budget_s: float, platform: str,
     _log(f"worker: exec-only p99 {p99:.2f}ms over {len(times)} iters "
          f"(first post-fetch dispatch: {post_fetch_ms:.1f}ms)")
 
+    # Chained exec estimator: per-call block_until_ready on a relayed
+    # backend can acknowledge the dispatch rather than the completion,
+    # making the exec-only loop read impossibly fast. N back-to-back
+    # dispatches of a NON-donating build of the same program share one
+    # compute stream, so a 4-byte scalar reduced from the LAST output
+    # can only arrive after all N programs really ran:
+    #   wall = N * exec + scalar_RTT  =>  exec ~= (wall - RTT) / N.
+    chain = {}
+    # TPU only: local backends' block_until_ready is truthful, and the
+    # second compile would eat the CPU worker's whole budget.
+    if plat == "tpu" and time.monotonic() < deadline - 30.0:
+        prog_nd = pipeline._flush_executable(
+            dev, COMPRESSION, False, agg_emit,
+            plat in ("tpu", "axon"), donate=False)
+        scalar_of = jax.jit(jnp.sum)
+        args = jax.tree_util.tree_map(jnp.copy, (bank,) + small)
+        jax.block_until_ready(args)
+        t0 = time.monotonic()
+        float(scalar_of(prog_nd(*args, qs)["q"]))  # compile both
+        chain_compile_s = time.monotonic() - t0
+        # scalar round-trip time, on its own
+        rtts = []
+        for i in range(3):
+            fresh = jnp.full((1,), float(i), jnp.float32)
+            jax.block_until_ready(fresh)
+            t0 = time.monotonic()
+            float(fresh[0])
+            rtts.append(time.monotonic() - t0)
+        rtt_s = sorted(rtts)[1]
+        n_chain = 20
+        t0 = time.monotonic()
+        outs = None
+        for i in range(n_chain):
+            outs = prog_nd(*args, qs)
+        float(scalar_of(outs["q"]))
+        wall_s = time.monotonic() - t0
+        chain = {
+            "exec_chain_ms_per_iter": round(
+                max(wall_s - rtt_s, 0.0) / n_chain * 1000.0, 3),
+            "chain_n": n_chain,
+            "chain_rtt_ms": round(rtt_s * 1000.0, 1),
+            "chain_compile_s": round(chain_compile_s, 1),
+        }
+        _log(f"worker: chain est {chain['exec_chain_ms_per_iter']:.2f}"
+             f"ms/iter over {n_chain} (rtt {rtt_s * 1000:.0f}ms)")
+
     # Fetch cost, measured on 3 dispatch+fetch rounds (each fetch poisons
     # the NEXT dispatch — visible in the exec column, kept out of the
     # fetch medians).
@@ -323,7 +369,17 @@ def worker(k: int, budget_s: float, platform: str,
     # hardware would not pay — vs_baseline_ex_transport is the target
     # ratio with the MEASURED wire floor subtracted, exec_p99_ms is the
     # pure program latency.
-    headline = e2e.get("e2e_p99_ms", p99)
+    # When the e2e phase was deadline-skipped, fall back to the CHAIN
+    # estimate, not the exec-only p99: per-call block_until_ready on the
+    # relayed backend can acknowledge dispatch rather than completion,
+    # so an exec-only headline could claim an impossibly fast win.
+    if "e2e_p99_ms" in e2e:
+        headline, headline_src = e2e["e2e_p99_ms"], "e2e"
+    elif chain:
+        headline = chain["exec_chain_ms_per_iter"]
+        headline_src = "chain"
+    else:
+        headline, headline_src = p99, "exec_only"
     vs = round(TARGET_MS / headline, 3) if k >= 100_000 else 0.0
     out_rec = {
         "metric": f"flush_merge_p99_ms_{k // 1000}k_histos_{plat}",
@@ -332,12 +388,14 @@ def worker(k: int, budget_s: float, platform: str,
         "vs_baseline": vs,
         "k": k,
         "platform": plat,
+        "headline_source": headline_src,
         "exec_p99_ms": round(p99, 3),
         "exec_iters": len(times),
         "post_fetch_dispatch_ms": round(post_fetch_ms, 1),
         "compile_s": round(compile_s, 1),
         "prog_fetch_med_ms": round(fetch_med, 1),
         "fetch_mode": best_mode,
+        **chain,
         **e2e,
     }
     if mode_table:

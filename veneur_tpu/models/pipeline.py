@@ -161,7 +161,8 @@ def _ingest_executables(device, compression):
 
 
 @functools.lru_cache(maxsize=None)
-def _flush_executable(device, compression, fwd_out, agg_emit, pallas_ok):
+def _flush_executable(device, compression, fwd_out, agg_emit, pallas_ok,
+                      donate=True):
     """The fused interval-flush program: compress + quantiles + the
     configured aggregates + counter/gauge/set finalization in ONE XLA
     call, returning only the compact arrays the host assembly needs
@@ -215,7 +216,11 @@ def _flush_executable(device, compression, fwd_out, agg_emit, pallas_ok):
                 s_regs=sb.registers)
         return out
 
-    return jax.jit(program, donate_argnums=(0, 1, 2, 3),
+    # donate=False builds a variant safe to dispatch repeatedly on the
+    # same banks (bench.py's chained exec estimator); serving always
+    # donates.
+    return jax.jit(program,
+                   donate_argnums=(0, 1, 2, 3) if donate else (),
                    out_shardings=sds)
 
 
@@ -368,6 +373,10 @@ class AggregationEngine:
         if self.cfg.buffer_depth < 8:
             raise ValueError("buffer_depth must be >= 8 (hot-slot "
                              "pre-clustering needs usable bucket room)")
+        if self.cfg.flush_fetch not in ("sync", "staged", "host", "async"):
+            raise ValueError(
+                f"flush_fetch={self.cfg.flush_fetch!r}: must be "
+                "sync/staged/host/async")
         # One ingest thread owns process(); flush() may run from another
         # thread. The lock is the Worker.Flush mutex-swap equivalent:
         # ingest holds it per item; flush holds it ONLY across
@@ -898,7 +907,11 @@ class AggregationEngine:
         `flush_fetch` picks how the fetch is performed (see EngineConfig).
         Overridden by the mesh engine."""
         hb, cb, gb, sb = snap
-        out = self._flush_exec(hb, cb, gb, sb, self._qs)
+        return self._fetch_flush(self._flush_exec(hb, cb, gb, sb, self._qs))
+
+    def _fetch_flush(self, out):
+        """device_get under the configured flush_fetch mode (shared with
+        the mesh engine's _flush_device)."""
         if self._stage_exec is not None:
             out = self._stage_exec(out)
         elif self.cfg.flush_fetch == "async":

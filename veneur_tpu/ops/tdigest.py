@@ -365,8 +365,15 @@ def merge_scalars(bank: TDigestBank, slots, vmins, vmaxs, vsums, counts,
 def quantile(bank: TDigestBank, qs) -> jax.Array:
     """Batched MergingDigest.Quantile: [K] digests x [P] quantiles -> [K, P].
 
-    Requires compressed state (empty buffers) — the flush program compresses
-    first. Centroid i's mass is centered at quantile (cum_i - w_i/2) / W;
+    Requires compressed, cluster-ordered state (empty buffers) — the
+    output of _compress_impl/_cluster_core: per-row means non-decreasing
+    over the positive-weight prefix, with zero-weight empties as a
+    suffix (cluster ids are consecutive by construction, so an interior
+    cluster always has weight > 0). Every caller compresses first, which
+    is why no defensive re-sort happens here: it would be a second full
+    row sort per flush, measured at ~30% of the whole CPU flush @100k.
+
+    Centroid i's mass is centered at quantile (cum_i - w_i/2) / W;
     linear interpolation between adjacent centroid means, clamped into
     [vmin, vmax], with the min/max themselves used below the first / above
     the last centroid midpoint (matching the reference's edge handling).
@@ -375,11 +382,7 @@ def quantile(bank: TDigestBank, qs) -> jax.Array:
     qs = jnp.asarray(qs, bank.mean.dtype)
     P = qs.shape[0]
 
-    w = bank.weight
-    # Rows are sorted by mean after compress, but empty clusters (w==0) can
-    # appear anywhere; re-sort by (mean with empties at +inf).
-    keys = jnp.where(w > 0, bank.mean, _INF)
-    means, w = jax.lax.sort((keys, w), dimension=-1, num_keys=1)
+    means, w = bank.mean, bank.weight
 
     total = jnp.sum(w, axis=1, keepdims=True)
     safe_total = jnp.where(total > 0, total, 1.0)

@@ -99,15 +99,7 @@ class MeshAggregationEngine(AggregationEngine):
             # copy program, not the collective merge.
             self._stage_exec = jax.jit(
                 lambda t: jax.tree_util.tree_map(jnp.copy, t))
-
-    def _fetch_flush(self, out):
-        """device_get under the configured flush_fetch mode."""
-        if self._stage_exec is not None:
-            out = self._stage_exec(out)
-        elif self.cfg.flush_fetch == "async":
-            for leaf in jax.tree_util.tree_leaves(out):
-                leaf.copy_to_host_async()
-        return jax.device_get(out)
+    # _fetch_flush is inherited from AggregationEngine.
 
     # ---------------- ingest ----------------
     # Staged batches carry GLOBAL slot ids straight from the interners;
