@@ -339,3 +339,35 @@ class TestAdvisorRegressions:
                 keys[0][4], "counter", keys[0][5]) == pm.digest
         finally:
             br.close()
+
+
+class TestByteFuzz:
+    """Raw byte-level fuzz: arbitrary byte soup and mutated valid lines.
+    Neither parser may crash, and verdicts/values must stay conformant
+    (the structured randomized test above only composes well-formed
+    fragments; this one covers delimiter pile-ups, NULs, truncations,
+    and high bytes — parse_test.go's malformed-input corner, widened)."""
+
+    def test_byte_soup(self):
+        rng = random.Random(7)
+        alphabet = b"abc:|#@,.0123456789-+eE\x00\xffg\ns "
+        for _ in range(5000):
+            n = rng.randrange(0, 60)
+            line = bytes(rng.choice(alphabet) for _ in range(n))
+            assert_conformant(line)
+
+    def test_mutated_valid_lines(self):
+        rng = random.Random(11)
+        seeds = [v[0] for v in VALID]
+        for _ in range(5000):
+            line = bytearray(rng.choice(seeds))
+            for _ in range(rng.randrange(1, 4)):
+                op = rng.randrange(3)
+                if op == 0 and line:                  # flip a byte
+                    line[rng.randrange(len(line))] = rng.randrange(256)
+                elif op == 1 and line:                # truncate
+                    del line[rng.randrange(len(line)):]
+                else:                                 # duplicate a span
+                    i = rng.randrange(len(line) + 1)
+                    line[i:i] = line[:rng.randrange(8)]
+            assert_conformant(bytes(line))
