@@ -1,0 +1,48 @@
+#!/bin/bash
+# One-shot TPU evidence capture for when the relay comes alive.
+# The relay dies unpredictably (TPU_EVIDENCE_r04.md), so this runs the
+# cheapest/highest-value probes first and commits nothing itself — run
+# it, then check in whatever it produced.
+#
+#   bash capture_tpu_window.sh [outdir]
+#
+# Produces in outdir (default .):
+#   BENCH_r04_tpu_live.json      bench.py JSON (mode table, chain est, e2e)
+#   PALLAS_VALIDATION.json       Pallas-HLL vs jnp estimator on real TPU
+#                                (written by pallas_validate.py into the
+#                                repo dir, then copied to outdir)
+#   tpu_window_*.log             output for each step
+set -u
+cd "$(dirname "$0")"
+OUT="${1:-.}"
+TS=$(date -u +%Y%m%dT%H%M%SZ)
+
+alive=$(timeout 90 python -c "
+from veneur_tpu.utils.platform import tunnel_alive
+print('yes' if tunnel_alive() else 'no')" 2>/dev/null | tail -1)
+if [ "$alive" != "yes" ]; then
+    echo "relay dead; nothing captured"
+    exit 1
+fi
+echo "relay alive at $TS — capturing"
+
+# 1. Pallas validation first: cheapest, never captured on real TPU yet.
+#    Writes PALLAS_VALIDATION.json itself on success.
+timeout 420 python native/pallas_validate.py \
+    > "$OUT/tpu_window_pallas_$TS.log" 2>&1
+rc=$?
+[ -f PALLAS_VALIDATION.json ] && [ "$OUT" != "." ] \
+    && cp PALLAS_VALIDATION.json "$OUT/"
+echo "pallas_validate rc=$rc (artifact: PALLAS_VALIDATION.json)"
+
+# 2. The north-star bench: exec/fetch split, fetch-mode probe, chain
+#    estimator, e2e under the best mode.
+BENCH_BUDGET_S=500 timeout 560 python bench.py \
+    > "$OUT/BENCH_r04_tpu_live.json.tmp" 2> "$OUT/tpu_window_bench_$TS.log"
+rc=$?
+if [ $rc -eq 0 ] && grep -q '"platform": "tpu"' "$OUT/BENCH_r04_tpu_live.json.tmp"; then
+    mv "$OUT/BENCH_r04_tpu_live.json.tmp" "$OUT/BENCH_r04_tpu_live.json"
+    echo "bench captured: $(cat "$OUT/BENCH_r04_tpu_live.json")"
+else
+    echo "bench rc=$rc or not platform=tpu; keeping .tmp for forensics"
+fi
