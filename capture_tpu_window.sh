@@ -13,34 +13,42 @@
 #                                repo dir, then copied to outdir)
 #   tpu_window_*.log             output for each step
 set -u
-cd "$(dirname "$0")"
 OUT="${1:-.}"
+# resolve OUT before cd so a relative outdir means "relative to the
+# caller", then make sure it exists — a failed redirect would silently
+# waste the relay window
+OUT="$(mkdir -p "$OUT" && cd "$OUT" && pwd)" || exit 1
+cd "$(dirname "$0")"
 TS=$(date -u +%Y%m%dT%H%M%SZ)
 
-alive=$(timeout 90 python -c "
-from veneur_tpu.utils.platform import tunnel_alive
-print('yes' if tunnel_alive() else 'no')" 2>/dev/null | tail -1)
+alive=$(timeout 150 python -c "
+from veneur_tpu.utils.platform import tunnel_healthy
+print('yes' if tunnel_healthy(timeout_s=120) else 'no')" 2>/dev/null | tail -1)
 if [ "$alive" != "yes" ]; then
-    echo "relay dead; nothing captured"
+    echo "relay dead or unhealthy; nothing captured"
     exit 1
 fi
-echo "relay alive at $TS — capturing"
+echo "relay healthy at $TS — capturing"
 
 # 1. Pallas validation first: cheapest, never captured on real TPU yet.
 #    Writes PALLAS_VALIDATION.json itself on success.
 timeout 420 python native/pallas_validate.py \
     > "$OUT/tpu_window_pallas_$TS.log" 2>&1
 rc=$?
-[ -f PALLAS_VALIDATION.json ] && [ "$OUT" != "." ] \
-    && cp PALLAS_VALIDATION.json "$OUT/"
-echo "pallas_validate rc=$rc (artifact: PALLAS_VALIDATION.json)"
+if [ $rc -eq 0 ] && [ -f PALLAS_VALIDATION.json ]; then
+    [ "$OUT" != "$(pwd)" ] && cp PALLAS_VALIDATION.json "$OUT/"
+    echo "pallas_validate OK (artifact: PALLAS_VALIDATION.json)"
+else
+    echo "pallas_validate rc=$rc — no fresh artifact (a pre-existing"\
+         "PALLAS_VALIDATION.json, if any, is from an EARLIER window)"
+fi
 
 # 2. The north-star bench: exec/fetch split, fetch-mode probe, chain
 #    estimator, e2e under the best mode.
 BENCH_BUDGET_S=500 timeout 560 python bench.py \
     > "$OUT/BENCH_r04_tpu_live.json.tmp" 2> "$OUT/tpu_window_bench_$TS.log"
 rc=$?
-if [ $rc -eq 0 ] && grep -q '"platform": "tpu"' "$OUT/BENCH_r04_tpu_live.json.tmp"; then
+if [ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)"' "$OUT/BENCH_r04_tpu_live.json.tmp"; then
     mv "$OUT/BENCH_r04_tpu_live.json.tmp" "$OUT/BENCH_r04_tpu_live.json"
     echo "bench captured: $(cat "$OUT/BENCH_r04_tpu_live.json")"
 else

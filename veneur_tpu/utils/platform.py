@@ -57,3 +57,33 @@ def tunnel_alive() -> bool:
         except OSError:
             continue
     return False
+
+
+def tunnel_healthy(timeout_s: float = 90.0) -> bool:
+    """Stronger liveness probe: port-accept alone can lie (the relay
+    accepts TCP while the device session hangs — observed r4, see
+    TUNNEL_PROBE_r04.jsonl). A disposable subprocess initializes the
+    default backend, runs one op, and fetches the result under a hard
+    timeout; only a full round trip counts as healthy. The subprocess
+    runs from the repo root because the axon plugin only registers
+    there."""
+    if not tunnel_alive():
+        return False
+    import subprocess
+    import sys
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "d = jax.devices()[0]\n"
+        "x = jnp.ones((8,), jnp.float32)\n"
+        "ok = float(x.sum()) == 8.0 and d.platform in ('tpu', 'axon')\n"
+        "print('HEALTHY' if ok else 'BAD')\n"
+    )
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=repo_root)
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    return p.returncode == 0 and "HEALTHY" in p.stdout
