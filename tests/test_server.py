@@ -193,3 +193,37 @@ def test_config_validation_rejects_nonsense():
     # lenient like the reference: unknown aggregates warn, don't fail
     cfg = read_config(text="aggregates: ['count', 'p9999']")
     assert cfg.aggregates == ["count", "p9999"]
+
+
+@pytest.mark.slow
+def test_key_churn_soak_bounded_state():
+    """Long-running-server soak: 40 flush intervals of fully-churning
+    key sets must leave every unbounded-looking cache bounded — the
+    leak class the datadog tag-memo advisor finding belonged to
+    (interners evict by TTL, presentation caches clear at their bound,
+    sink memos stay under their cap)."""
+    from veneur_tpu.ingest import parser
+    from veneur_tpu.models.pipeline import AggregationEngine, EngineConfig
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    from veneur_tpu.metrics import FrameSet
+
+    eng = AggregationEngine(EngineConfig(
+        histogram_slots=512, counter_slots=256, gauge_slots=128,
+        set_slots=64, buffer_depth=128, idle_ttl_intervals=4))
+    sink = DatadogMetricSink(api_key="x", interval_s=10)
+    sink._post = lambda path, body: None  # capture nothing, reach no API
+    for interval in range(40):
+        for j in range(300):  # fresh names every interval -> full churn
+            eng.process(parser.parse_packet(
+                f"churn.{interval}.{j}:1|ms|#iter:{interval}".encode()))
+            eng.process(parser.parse_packet(
+                f"churn.c.{interval}.{j}:1|c".encode()))
+        res = eng.flush(timestamp=interval * 10)
+        sink.flush_frames(FrameSet([res.frame]))
+    # interners: evicted down to live + ttl window, never the cumulative
+    # 12k keys this soak produced
+    assert len(eng.histo_keys) <= 512
+    assert len(eng.counter_keys) <= 256
+    # presentation caches bounded by their documented caps
+    assert len(eng._tags_cache) <= eng._pres_bound
+    assert len(sink._tag_memo) < 65536
