@@ -15,6 +15,9 @@ must be sustained:
   5 100k multi-chip  — the flush-merge program over a (1, D)-device mesh
                        sharding 100k histogram slots (ICI analogue; on
                        one real chip D=1, on the CPU mesh D=8).
+                       `--config 9` (c5b) covers the config's span arm:
+                       SSF datagram decode -> span worker -> ssfmetrics
+                       bridge -> metric staging, spans/s.
 
 Run: python bench_suite.py [--config N]
 """
@@ -316,6 +319,76 @@ def config4_forward_merge_32_shards():
         noise.append(abs(a - b) / abs(a))
     _emit("c4_go_merge_order_variance_p99", float(np.max(noise)),
           "ratio", None, larger_is_better=False)
+
+
+def config5b_ssf_span_ingest():
+    """BASELINE config 5's span arm: SSF datagram decode -> span worker
+    fan-out -> ssfmetrics bridge -> metric staging, spans/s. Each span
+    carries two embedded samples (a ms timing and a counter), the shape
+    an instrumented app actually emits; bridged metric landing is
+    asserted so the rate covers the whole span->metric leg."""
+    from veneur_tpu.config import Config
+    from veneur_tpu.server import Server
+    from veneur_tpu.sinks.basic import BlackholeMetricSink
+    from veneur_tpu.ssf import framing
+    from veneur_tpu.ssf.protos import ssf_pb2
+
+    cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                 interval="3600s", hostname="bench",
+                 tpu_histogram_slots=1 << 12, tpu_counter_slots=1 << 12,
+                 tpu_gauge_slots=1 << 8, tpu_set_slots=1 << 8)
+    srv = Server(cfg, sinks=[BlackholeMetricSink()], plugins=[])
+    srv.start()
+
+    def mk_span(i):
+        sp = ssf_pb2.SSFSpan()
+        sp.version = 1
+        sp.trace_id = i + 1
+        sp.id = i + 1
+        sp.parent_id = i
+        sp.start_timestamp = 1_700_000_000_000_000_000 + i
+        sp.end_timestamp = sp.start_timestamp + 5_000_000
+        sp.service = "bench-svc"
+        sp.name = f"op.{i % 64}"
+        sp.tags["env"] = "prod"
+        m1 = sp.metrics.add()
+        m1.metric = ssf_pb2.SSFSample.HISTOGRAM
+        m1.name = f"svc.latency.{i % 256}"
+        m1.value = 1.0 + (i % 100)
+        m1.unit = "ms"
+        m1.sample_rate = 1.0
+        m2 = sp.metrics.add()
+        m2.metric = ssf_pb2.SSFSample.COUNTER
+        m2.name = f"svc.calls.{i % 256}"
+        m2.value = 1.0
+        m2.sample_rate = 1.0
+        return sp.SerializeToString()
+
+    n = 50_000
+    datagrams = [mk_span(i) for i in range(n)]
+    t0 = time.perf_counter()
+    for data in datagrams:
+        # blocking put: this measures sustained span throughput; the
+        # drop-on-full path (handle_ssf_span) is burst behavior and is
+        # covered by the server tests
+        srv.span_queue.put(framing.parse_ssf_datagram(data))
+    srv.span_queue.join()          # span worker fan-out complete
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    assert srv.drain(), "drain timed out settling bridged metrics"
+    landed = sum(e.samples_processed for e in srv.engines)
+    drops = srv.queue_drops
+    srv.stop()
+    _emit("c5b_ssf_span_ingest_spans_per_sec", rate, "spans/s", 100_000,
+          spans=n, bridged_samples_landed=int(landed),
+          queue_drops=int(drops), platform=_platform())
+    # 2 samples per span; under burst the worker queues drop-on-full by
+    # design (counted) — every sample must be accounted one way or the
+    # other, and the bridge must have landed a meaningful share
+    assert landed + drops >= 2 * n, \
+        f"samples unaccounted: landed={landed} drops={drops} expect>={2*n}"
+    assert landed >= n, \
+        f"bridge landed {landed}, below the n={n} floor (of {2*n} total)"
 
 
 def config6_e2e_udp_ingest(seconds: float = 8.0):
@@ -688,6 +761,7 @@ def config8_ingest_stages():
 CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            3: config3_sets_1m_uniques, 4: config4_forward_merge_32_shards,
            5: config5_multichip_100k, 6: config6_e2e_udp_ingest,
+           9: config5b_ssf_span_ingest,
            7: config7_mesh_global_merge, 8: config8_ingest_stages}
 
 

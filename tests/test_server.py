@@ -207,8 +207,12 @@ def test_key_churn_soak_bounded_state():
     from veneur_tpu.sinks.datadog import DatadogMetricSink
     from veneur_tpu.metrics import FrameSet
 
+    # capacity ABOVE the churn live-window (300 keys/interval, TTL 4 ->
+    # ~1500 live) so slot exhaustion never masks broken eviction: if TTL
+    # eviction stopped returning slots to the free list, the cumulative
+    # 12k keys would exhaust the bank and dropped_no_slot would fire
     eng = AggregationEngine(EngineConfig(
-        histogram_slots=512, counter_slots=256, gauge_slots=128,
+        histogram_slots=2048, counter_slots=2048, gauge_slots=128,
         set_slots=64, buffer_depth=128, idle_ttl_intervals=4))
     sink = DatadogMetricSink(api_key="x", interval_s=10)
     sink._post = lambda path, body: None  # capture nothing, reach no API
@@ -220,10 +224,13 @@ def test_key_churn_soak_bounded_state():
                 f"churn.c.{interval}.{j}:1|c".encode()))
         res = eng.flush(timestamp=interval * 10)
         sink.flush_frames(FrameSet([res.frame]))
-    # interners: evicted down to live + ttl window, never the cumulative
-    # 12k keys this soak produced
-    assert len(eng.histo_keys) <= 512
-    assert len(eng.counter_keys) <= 256
+    # eviction keeps the interner inside the live+TTL window and no key
+    # was ever dropped for want of a slot (the non-vacuous check: broken
+    # eviction exhausts the free list and fires dropped_no_slot)
+    assert eng.histo_keys.dropped_no_slot == 0
+    assert eng.counter_keys.dropped_no_slot == 0
+    assert len(eng.histo_keys) <= 300 * (4 + 2)
+    assert len(eng.counter_keys) <= 300 * (4 + 2)
     # presentation caches bounded by their documented caps
     assert len(eng._tags_cache) <= eng._pres_bound
     assert len(sink._tag_memo) < 65536
