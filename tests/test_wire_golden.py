@@ -230,3 +230,84 @@ def test_ssf_stream_frame_golden_bytes():
     import io
     back = framing.read_ssf(io.BytesIO(frame))
     assert back.id == 200 and back.name == "op"
+
+
+class TestRandomizedRoundtrip:
+    """Randomized encode->bytes->decode roundtrips over the forward wire
+    (golden tests above pin fixed bytes; these harden the rest of the
+    value space: random centroids, unicode/odd tags, extreme floats —
+    protocol/wire_test.go's roundtrip property, widened)."""
+
+    def test_export_metrics_roundtrip(self):
+        import random
+        rng = random.Random(5)
+        from veneur_tpu.cluster import wire
+        from veneur_tpu.cluster.protos import metric_pb2
+        from veneur_tpu.ingest.parser import MetricKey
+        from veneur_tpu.models.pipeline import ForwardExport
+
+        tag_pool = ["env:prod", "høst:ünicøde",
+                    "emoji:\U0001f600", "empty:", "k:v:w", "plain"]
+        for trial in range(200):
+            n_cent = rng.randrange(0, 60)
+            means = np.sort(np.float32(
+                [rng.uniform(-1e30, 1e30) for _ in range(n_cent)]))
+            weights = np.float32(
+                [rng.choice([1.0, 0.5, 3.25, 1e-3, 1e7])
+                 for _ in range(n_cent)])
+            tags = ",".join(sorted(rng.sample(tag_pool,
+                                              rng.randrange(0, 4))))
+            key = MetricKey(f"m.{trial}", "timer", tags)
+            vmin = float(means.min()) if n_cent else 0.0
+            vmax = float(means.max()) if n_cent else 0.0
+            exp = ForwardExport(histograms=[
+                (key, means, weights, vmin, vmax,
+                 float(np.float32(means.sum())), float(weights.sum()),
+                 0.25)])
+            pbs = wire.export_to_metrics(exp)
+            data = [m.SerializeToString() for m in pbs]
+            back = [metric_pb2.Metric.FromString(d) for d in data]
+            assert len(back) == 1
+            m = back[0]
+            assert wire.metric_key_of(m) == key  # type survives (Timer)
+            td = m.histogram.t_digest
+            got_means = np.float32([c.mean for c in td.centroids])
+            got_w = np.float32([c.weight for c in td.centroids])
+            live = weights > 0
+            np.testing.assert_array_equal(got_means, means[live])
+            np.testing.assert_array_equal(got_w, weights[live])
+            assert np.float32(td.min) == np.float32(vmin)
+            assert np.float32(td.max) == np.float32(vmax)
+            assert np.float32(td.count) == np.float32(weights.sum())
+
+    def test_hll_roundtrip_random(self):
+        import random
+        rng = random.Random(9)
+        from veneur_tpu.cluster import wire
+        for p in (4, 10, 14):
+            for _ in range(20):
+                regs = np.array([rng.randrange(0, 64)
+                                 for _ in range(1 << p)], np.uint8)
+                np.testing.assert_array_equal(
+                    wire.decode_hll(wire.encode_hll(regs)), regs)
+
+    def test_ssf_frame_roundtrip_random(self):
+        import io
+        import random
+        rng = random.Random(13)
+        from veneur_tpu.ssf import framing
+        from veneur_tpu.ssf.protos import ssf_pb2
+        for trial in range(100):
+            sp = ssf_pb2.SSFSpan()
+            sp.version = 1
+            sp.trace_id = rng.randrange(1, 1 << 63)
+            sp.id = rng.randrange(1, 1 << 63)
+            sp.name = "op-é" * rng.randrange(1, 20)
+            sp.service = "svc"
+            sp.indicator = bool(rng.randrange(2))
+            for i in range(rng.randrange(0, 5)):
+                sp.tags[f"k{i}"] = "v" * rng.randrange(0, 50)
+            buf = io.BytesIO(framing.write_ssf(sp))
+            back = framing.read_ssf(buf)
+            assert back is not None and back.SerializeToString() == \
+                sp.SerializeToString()
