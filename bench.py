@@ -250,17 +250,15 @@ def worker(k: int, budget_s: float, platform: str,
     mode_table = {}
     best_mode = fetch_mode if fetch_mode != "probe" else "sync"
     if fetch_mode == "probe":
-        sds = jax.sharding.SingleDeviceSharding(dev)
-
         def make_stage(sharding):
-            s = jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t),
-                        out_shardings=sharding)
+            s = pipeline.stage_copy_executable(sharding)
             jax.device_get(s(jnp.zeros(8, jnp.float32)))  # probe support
             return s
 
         stages = {"sync": None, "async": None}
         try:
-            stages["staged"] = make_stage(sds)
+            stages["staged"] = make_stage(
+                jax.sharding.SingleDeviceSharding(dev))
             stages["host"] = make_stage(jax.sharding.SingleDeviceSharding(
                 dev, memory_kind="pinned_host"))
         except Exception as exc:
@@ -274,12 +272,7 @@ def worker(k: int, budget_s: float, platform: str,
                 jax.block_until_ready(copy)
                 t0 = time.monotonic()
                 out = prog(*copy, qs)
-                if stage is not None:
-                    out = stage(out)
-                elif mode == "async":
-                    for leaf in jax.tree_util.tree_leaves(out):
-                        leaf.copy_to_host_async()
-                jax.device_get(out)
+                pipeline.fetch_flush_outputs(out, mode, stage)
                 rounds.append((time.monotonic() - t0) * 1000.0)
             rounds.sort()
             mode_table[mode] = round(rounds[len(rounds) // 2], 1)

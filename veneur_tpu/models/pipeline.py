@@ -224,6 +224,27 @@ def _flush_executable(device, compression, fwd_out, agg_emit, pallas_ok,
                    out_shardings=sds)
 
 
+def stage_copy_executable(sharding=None):
+    """A jitted tree-copy program used as a fetch 'staging' hop: the
+    host fetch targets THIS cheap executable's outputs, so a relayed
+    backend's fetch-side invalidation (TPU_EVIDENCE_r04.md §2) costs a
+    tiny recompile, not the serving program's. `sharding=None` keeps the
+    inputs' shardings (the mesh case)."""
+    kw = {} if sharding is None else {"out_shardings": sharding}
+    return jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t), **kw)
+
+
+def fetch_flush_outputs(out, mode: str, stage_exec=None):
+    """device_get under a flush_fetch mode — the one definition shared
+    by both engines and bench.py's mode probe."""
+    if stage_exec is not None:
+        out = stage_exec(out)
+    elif mode == "async":
+        for leaf in jax.tree_util.tree_leaves(out):
+            leaf.copy_to_host_async()
+    return jax.device_get(out)
+
+
 @dataclass
 class EngineConfig:
     histogram_slots: int = 1 << 15
@@ -347,25 +368,21 @@ class AggregationEngine:
         self._stage_exec = None
         mode = cfg.flush_fetch
         if mode in ("staged", "host"):
-            def make_stage(sharding):
-                return jax.jit(
-                    lambda t: jax.tree_util.tree_map(jnp.copy, t),
-                    out_shardings=sharding)
-
             if mode == "host":
                 # pinned_host support only shows up at compile/run time
                 # (CPU constructs the sharding fine, then fails with "no
                 # registered implementation ... for Host") — probe it.
                 try:
-                    stage = make_stage(jax.sharding.SingleDeviceSharding(
-                        self._device, memory_kind="pinned_host"))
+                    stage = stage_copy_executable(
+                        jax.sharding.SingleDeviceSharding(
+                            self._device, memory_kind="pinned_host"))
                     jax.device_get(stage(jnp.zeros(8, jnp.float32)))
                     self._stage_exec = stage
                 except Exception:
                     logger.warning("flush_fetch=host: backend lacks "
                                    "pinned_host memory; using staged")
             if self._stage_exec is None:
-                self._stage_exec = make_stage(
+                self._stage_exec = stage_copy_executable(
                     jax.sharding.SingleDeviceSharding(self._device))
 
     def __init__(self, config: EngineConfig | None = None):
@@ -912,12 +929,8 @@ class AggregationEngine:
     def _fetch_flush(self, out):
         """device_get under the configured flush_fetch mode (shared with
         the mesh engine's _flush_device)."""
-        if self._stage_exec is not None:
-            out = self._stage_exec(out)
-        elif self.cfg.flush_fetch == "async":
-            for leaf in jax.tree_util.tree_leaves(out):
-                leaf.copy_to_host_async()
-        return jax.device_get(out)
+        return fetch_flush_outputs(out, self.cfg.flush_fetch,
+                                   self._stage_exec)
 
     def flush(self, timestamp: int | None = None) -> FlushResult:
         """The Server.Flush equivalent: snapshot banks, run the merge

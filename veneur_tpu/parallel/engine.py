@@ -35,7 +35,7 @@ import numpy as np
 
 from ..ingest.parser import GLOBAL_ONLY
 from ..models.pipeline import (AggregationEngine, EngineConfig,
-                               _precluster_k1)
+                               _precluster_k1, stage_copy_executable)
 from .mesh import MeshEngine, make_mesh
 
 logger = logging.getLogger(__name__)
@@ -93,12 +93,8 @@ class MeshAggregationEngine(AggregationEngine):
                 logger.warning("flush_fetch=host is not supported on the "
                                "mesh engine; using staged")
             # No out_shardings: outputs keep the mesh flush program's
-            # shardings — the point is that the fetch targets THIS cheap
-            # executable's outputs, so a relayed backend's fetch-side
-            # invalidation (TPU_EVIDENCE_r04.md §2) re-uploads the tiny
-            # copy program, not the collective merge.
-            self._stage_exec = jax.jit(
-                lambda t: jax.tree_util.tree_map(jnp.copy, t))
+            # shardings.
+            self._stage_exec = stage_copy_executable()
     # _fetch_flush is inherited from AggregationEngine.
 
     # ---------------- ingest ----------------
