@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-5 relay watcher: poll the axon tunnel and fire the evidence
+# capture the moment a live window opens. Run detached for hours:
+#
+#   nohup bash probe_relay_loop.sh > probe_loop.log 2>&1 &
+#
+# Probe timeline -> TUNNEL_PROBE_r05.jsonl (same schema as r4's);
+# each capture appends to capture_r05.log and drops the r05 artifacts
+# via capture_tpu_window.sh. A capture is attempted at most once per
+# 30 minutes so back-to-back healthy polls inside one window don't
+# re-burn it; a fresh window after that re-captures (newer scripts,
+# more evidence).
+cd "$(dirname "$0")"
+PROBE_LOG=TUNNEL_PROBE_r05.jsonl
+LAST_CAPTURE=0
+while true; do
+    ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+    now=$(date +%s)
+    alive=$(timeout 15 python -c "
+from veneur_tpu.utils.platform import tunnel_alive
+print(int(tunnel_alive()))" 2>/dev/null | tail -1)
+    alive=${alive:-0}
+    healthy=0
+    if [ "$alive" = "1" ]; then
+        healthy=$(timeout 150 python -c "
+from veneur_tpu.utils.platform import tunnel_healthy
+print(int(tunnel_healthy(timeout_s=120)))" 2>/dev/null | tail -1)
+        healthy=${healthy:-0}
+    fi
+    echo "{\"ts\": \"$ts\", \"alive\": $alive, \"healthy\": $healthy}" \
+        >> "$PROBE_LOG"
+    if [ "$healthy" = "1" ] && [ $((now - LAST_CAPTURE)) -gt 1800 ]; then
+        echo "{\"ts\": \"$ts\", \"event\": \"capture_start\"}" >> "$PROBE_LOG"
+        bash capture_tpu_window.sh . >> capture_r05.log 2>&1
+        rc=$?
+        LAST_CAPTURE=$(date +%s)
+        echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\"," \
+             "\"event\": \"capture_done\", \"rc\": $rc}" >> "$PROBE_LOG"
+        touch CAPTURE_FIRED_r05
+    fi
+    sleep 90
+done
