@@ -312,3 +312,54 @@ def test_flush_fetch_modes_identical(mode):
     assert got.keys() == ref.keys()
     for k in ref:
         np.testing.assert_allclose(got[k], ref[k], rtol=1e-6, err_msg=k)
+
+
+@pytest.mark.parametrize("mode", ["sync", "staged"])
+def test_flush_fetch_f16_compact(mode):
+    """Compact wire mode (flush_fetch_f16): count/sum stay exact (they
+    cross as f32 hi + sentinel-gated lo), quantiles and min/max land
+    within f16 rounding of the full-precision engine."""
+    lines = [b"c.hits:7|c", b"g.temp:70|g", b"s.u:alice|s", b"s.u:bob|s"]
+    lines += [f"t.req:{v}|ms".encode() for v in range(1, 201)]
+
+    ref_eng = AggregationEngine(small_config(
+        aggregates=("min", "max", "count", "sum")))
+    feed(ref_eng, lines)
+    ref = {(m.name, tuple(m.tags)): m.value
+           for m in ref_eng.flush(1000).metrics}
+
+    eng = AggregationEngine(small_config(
+        flush_fetch=mode, flush_fetch_f16=True,
+        aggregates=("min", "max", "count", "sum")))
+    eng.warmup()
+    feed(eng, lines)
+    got = {(m.name, tuple(m.tags)): m.value
+           for m in eng.flush(1000).metrics}
+    assert got.keys() == ref.keys()
+    for k in ref:
+        exact = (k[0].endswith((".count", ".sum"))
+                 or not k[0].startswith("t."))
+        np.testing.assert_allclose(
+            got[k], ref[k], rtol=0 if exact else 1e-3, err_msg=k)
+
+
+def test_flush_fetch_f16_out_of_range_falls_back_exact():
+    """Values outside f16's safe range (here > 65504) trip the
+    overflow sentinel and the host re-fetches the full-precision
+    twins — results must match the f32 engine exactly, not as inf."""
+    lines = [f"t.big:{v}|ms".encode()
+             for v in (1e5, 2e5, 3e5, 4e5, 5e5)] * 20
+    lines += [f"t.tiny:{v}|ms".encode()
+              for v in (1e-6, 2e-6, 3e-6)] * 20
+
+    ref_eng = AggregationEngine(small_config())
+    feed(ref_eng, lines)
+    ref = {m.name: m.value for m in ref_eng.flush(1000).metrics}
+
+    eng = AggregationEngine(small_config(flush_fetch_f16=True))
+    feed(eng, lines)
+    got = {m.name: m.value for m in eng.flush(1000).metrics}
+    assert got.keys() == ref.keys()
+    for k in ref:
+        assert np.isfinite(got[k]), k
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-6, err_msg=k)

@@ -341,6 +341,57 @@ class TestAdvisorRegressions:
             br.close()
 
 
+class TestPumpBufferAliasing:
+    def test_pump_dispatches_buffer_copies(self):
+        """The pump must hand the engine COPIES of its reused poll
+        buffers. jax's CPU client zero-copies page-aligned numpy arrays
+        into executable arguments, so an async kernel dispatch still
+        holds the buffer when the next poll overwrites it — observed
+        (r5) as both over- and under-counted banks at pump widths
+        >= 32768, where numpy's allocation becomes mmap'd/page-aligned.
+        The contract is checked structurally (no shared memory), which
+        is deterministic where the corruption itself is a timing race."""
+        br = native.NativeBridge(histo_slots=64, counter_slots=64,
+                                 gauge_slots=64, set_slots=64,
+                                 hll_precision=14, idle_ttl=4,
+                                 ring_capacity=4096, max_packet=8192)
+        captured = []
+
+        class StubEngine:
+            def ingest_histo_batch(self, slots, values, weights,
+                                   count=None, mark=None):
+                captured.append((slots, values, weights))
+
+            def ingest_counter_batch(self, slots, values, weights,
+                                     count=None, mark=None):
+                captured.append((slots, values, weights))
+
+            def ingest_gauge_batch(self, slots, values, count=None,
+                                   mark=None):
+                captured.append((slots, values))
+
+            def ingest_set_batch(self, slots, reg_idx, rho, count=None,
+                                 mark=None):
+                captured.append((slots, reg_idx, rho))
+
+        try:
+            views = {b: native.BridgeKeyView(br, b)
+                     for b in ("histo", "counter", "gauge", "set")}
+            pump = native.NativePump(br, StubEngine(), views,
+                                     lambda line: None, batch=256)
+            br.handle_packet(b"t:1|ms\nc:2|c\ng:3|g\ns:x|s")
+            assert pump.pump_once() == 4
+            assert len(captured) == 4
+            bufs = [arr for tup in pump._bufs.values() for arr in tup]
+            for tup in captured:
+                for arr in tup:
+                    assert not any(np.shares_memory(arr, b)
+                                   for b in bufs), \
+                        "pump passed a live poll buffer to the engine"
+        finally:
+            br.close()
+
+
 class TestByteFuzz:
     """Raw byte-level fuzz: arbitrary byte soup and mutated valid lines.
     Neither parser may crash, and verdicts/values must stay conformant

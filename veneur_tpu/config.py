@@ -125,6 +125,11 @@ class Config:
     # Non-sync modes work around relayed backends where a synchronous
     # device_get invalidates the serving executable (TPU_EVIDENCE_r04.md).
     tpu_flush_fetch: str = "sync"
+    # Compact wire mode: quantile/min/max columns fetched as f16 with
+    # sentinel-gated full-precision fallback; count/sum stay exact.
+    # Halves the flush fetch on transport-constrained rigs. Not
+    # supported with multi-device engines.
+    tpu_flush_fetch_f16: bool = False
 
     # --- native C++ ingest bridge (native/vtpu_ingest.cpp) ---
     # When on, UDP DogStatsD ingest (readers + parse + key interning +
@@ -132,6 +137,14 @@ class Config:
     # device-ready batches; one engine owns the full slot space.
     native_ingest: bool = False
     native_ring_capacity: int = 1 << 20
+    # Pump dispatch width (decoupled from tpu_batch_size, which sizes the
+    # per-sample staging path). Wider batches amortize per-dispatch cost
+    # (moderately on CPU — the t-digest scatter program is ~30ms/dispatch
+    # nearly flat in width; substantially on TPU, where dispatch+transfer
+    # overhead dominates the sub-ms kernel). 32k balances that against
+    # drain latency at flush time. See BENCH_SUITE c8_s5* and the
+    # buffer-aliasing note in NativePump._pump_bank.
+    native_pump_batch: int = 1 << 15
 
     # populated by the loader, not a YAML key:
     is_global: bool = False
@@ -195,13 +208,18 @@ def _validate(cfg: Config) -> None:
         log.warning("unknown aggregates %r ignored (known: %s)",
                     unknown, sorted(_KNOWN_AGGREGATES))
     for key in ("tpu_histogram_slots", "tpu_counter_slots",
-                "tpu_gauge_slots", "tpu_set_slots", "tpu_batch_size"):
+                "tpu_gauge_slots", "tpu_set_slots", "tpu_batch_size",
+                "native_pump_batch"):
         if getattr(cfg, key) <= 0:
             raise ValueError(f"{key} must be positive")
     if cfg.tpu_buffer_depth < 8:
         raise ValueError("tpu_buffer_depth must be >= 8")
     if not (4 <= cfg.tpu_hll_precision <= 16):
         raise ValueError("tpu_hll_precision must be in [4, 16]")
+    if cfg.tpu_flush_fetch_f16 and cfg.tpu_num_devices > 1:
+        raise ValueError(
+            "tpu_flush_fetch_f16 is not supported with tpu_num_devices > 1 "
+            "(the mesh flush program has its own wire layout)")
     if cfg.tpu_flush_fetch not in ("sync", "staged", "host", "async"):
         raise ValueError(
             "tpu_flush_fetch must be one of sync/staged/host/async")

@@ -494,17 +494,33 @@ class NativePump:
             # mirror entry drainable now — a flush interleaving after
             # dispatch can always resolve slot→key.
             self._sync_keys()
+            # COPY before dispatch — the kernels must never see the
+            # pump's reused poll buffers. jax's CPU client ZERO-COPIES
+            # page-aligned numpy arrays into executable arguments, so an
+            # async dispatch still holds the buffer when the next poll
+            # overwrites it (observed as both over- and under-counted
+            # banks at batch>=32768, where numpy's allocation becomes
+            # mmap'd/page-aligned; 8192-wide buffers happened to be
+            # heap-allocated, which the runtime copies). The Python
+            # staging path has the same contract — _Stage.drain()
+            # copies. A fresh copy is ~30us at 32k width vs the ~30ms
+            # scatter program it feeds.
+            sl = slots.copy()
             view = self.views[bank]
-            mark = lambda sl: view.mark(sl)  # runs under the engine lock
+            mark = lambda s_: view.mark(s_)  # runs under the engine lock
             eng = self.engine
             if bank == "histo":
-                eng.ingest_histo_batch(slots, a, b, count=n, mark=mark)
+                eng.ingest_histo_batch(sl, a.copy(), b.copy(), count=n,
+                                       mark=mark)
             elif bank == "counter":
-                eng.ingest_counter_batch(slots, a, b, count=n, mark=mark)
+                eng.ingest_counter_batch(sl, a.copy(), b.copy(), count=n,
+                                         mark=mark)
             elif bank == "gauge":
-                eng.ingest_gauge_batch(slots, a, count=n, mark=mark)
+                eng.ingest_gauge_batch(sl, a.copy(), count=n, mark=mark)
             else:
-                eng.ingest_set_batch(slots, c, a.astype(np.uint8),
+                # astype allocates fresh storage, which satisfies the
+                # aliasing contract for the rho column by itself
+                eng.ingest_set_batch(sl, c.copy(), a.astype(np.uint8),
                                      count=n, mark=mark)
             total += n
             if n < self.batch:

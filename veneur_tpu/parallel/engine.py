@@ -85,6 +85,10 @@ class MeshAggregationEngine(AggregationEngine):
     def _setup_flush_exec(self):
         # the MeshEngine owns the compiled flush; the single-device
         # _flush_executable is never built for a mesh engine
+        if self.cfg.flush_fetch_f16:
+            raise ValueError("flush_fetch_f16 is not supported on the "
+                             "mesh engine (its flush program has its own "
+                             "wire layout)")
         self._flush_exec = None
         self._stage_exec = None
         mode = self.cfg.flush_fetch

@@ -67,6 +67,7 @@ class Server:
             aggregates=tuple(cfg.aggregates),
             idle_ttl_intervals=cfg.tpu_slot_idle_ttl_intervals,
             flush_fetch=cfg.tpu_flush_fetch,
+            flush_fetch_f16=cfg.tpu_flush_fetch_f16,
             forward_enabled=bool(cfg.forward_address
                                  or cfg.consul_forward_service_name),
             # a server with a gRPC import listener is (also) a global tier
@@ -226,7 +227,7 @@ class Server:
 
         self.native_pump = NativePump(
             self.native_bridge, eng, views, slow_path,
-            batch=ecfg.batch_size)
+            batch=self.cfg.native_pump_batch)
 
     def _sinks_from_config(self) -> list[MetricSink]:
         out: list[MetricSink] = []
@@ -322,6 +323,11 @@ class Server:
         t0 = time.monotonic()
         for eng in self.engines:
             eng.warmup()
+        if self.native_pump is not None and \
+                self.native_pump.batch != self.engines[0].cfg.batch_size:
+            # the pump dispatches at its own width; compile those
+            # executables now, not inline under the ingest lock
+            self.engines[0].warm_ingest_kernels(self.native_pump.batch)
         warm_s = time.monotonic() - t0
         if warm_s > 1.0:
             log.info("engine warmup (device program compile): %.1fs",
