@@ -462,6 +462,35 @@ def config5b_ssf_span_ingest():
         m2.sample_rate = 1.0
         return sp.SerializeToString()
 
+    # Per-stage budget (VERDICT r4 item 6): where the Python path's
+    # ~35us/span goes. Measured on a 10k sample before the main run,
+    # with NON-overlapping stages: frame decode (protobuf C
+    # extension), sample extraction (sample_to_metric x2: tag
+    # sort/join/digest), and the per-sample engine staging the bridge's
+    # re-submitted metrics pay (a throwaway engine, so the measurement
+    # doesn't pollute the served one). The native twin (c5c) replaces
+    # all three.
+    from veneur_tpu.models.pipeline import AggregationEngine, EngineConfig
+    from veneur_tpu.sinks.ssfmetrics import sample_to_metric
+    probe = [mk_span(i) for i in range(10_000)]
+    t0 = time.perf_counter()
+    decoded = [framing.parse_ssf_datagram(d) for d in probe]
+    dec_us = (time.perf_counter() - t0) / len(probe) * 1e6
+    items = []
+    t0 = time.perf_counter()
+    for sp in decoded:
+        for s in sp.metrics:
+            items.append(sample_to_metric(s))
+    ext_us = (time.perf_counter() - t0) / len(probe) * 1e6
+    probe_eng = AggregationEngine(EngineConfig(
+        histogram_slots=1 << 10, counter_slots=1 << 10, gauge_slots=64,
+        set_slots=64))
+    probe_eng.warmup()  # keep executable compiles out of the timing
+    t0 = time.perf_counter()
+    for it in items:
+        probe_eng.process(it)
+    proc_us = (time.perf_counter() - t0) / len(probe) * 1e6
+
     n = 50_000
     datagrams = [mk_span(i) for i in range(n)]
     t0 = time.perf_counter()
@@ -479,7 +508,10 @@ def config5b_ssf_span_ingest():
     srv.stop()
     _emit("c5b_ssf_span_ingest_spans_per_sec", rate, "spans/s", 100_000,
           spans=n, bridged_samples_landed=int(landed),
-          queue_drops=int(drops), platform=_platform())
+          queue_drops=int(drops), platform=_platform(),
+          stage_decode_us_per_span=round(dec_us, 1),
+          stage_extract_us_per_span=round(ext_us, 1),
+          stage_engine_process_us_per_span=round(proc_us, 1))
     # 2 samples per span; under burst the worker queues drop-on-full by
     # design (counted) — every sample must be accounted one way or the
     # other, and the bridge must have landed a meaningful share
@@ -487,6 +519,71 @@ def config5b_ssf_span_ingest():
         f"samples unaccounted: landed={landed} drops={drops} expect>={2*n}"
     assert landed >= n, \
         f"bridge landed {landed}, below the n={n} floor (of {2*n} total)"
+
+
+def config5c_ssf_native_span_ingest():
+    """c5b's native twin: the same span shape through the C++ SSF fast
+    path (vtpu_handle_ssf: decode + extract + intern + ring staging in
+    one native call; the pump lands batches on device). c5b's
+    stage_*_us_per_span fields hold the measured per-span budget of
+    the Python pipeline this replaces (decode + extract + per-sample
+    engine staging, non-overlapping)."""
+    from veneur_tpu.config import Config
+    from veneur_tpu.server import Server
+    from veneur_tpu.sinks.basic import BlackholeMetricSink
+    from veneur_tpu.ssf.protos import ssf_pb2
+
+    cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                 ssf_listen_addresses=["udp://127.0.0.1:0"],
+                 interval="3600s", hostname="bench", native_ingest=True,
+                 num_readers=1, tpu_histogram_slots=1 << 12,
+                 tpu_counter_slots=1 << 12, tpu_gauge_slots=1 << 8,
+                 tpu_set_slots=1 << 8)
+    srv = Server(cfg, sinks=[BlackholeMetricSink()], plugins=[])
+    srv.start()
+    assert srv._native_ssf, "native SSF path not active"
+
+    def mk_span(i):
+        sp = ssf_pb2.SSFSpan()
+        sp.version = 1
+        sp.trace_id = i + 1
+        sp.id = i + 1
+        sp.service = "bench-svc"
+        sp.name = f"op.{i % 64}"
+        sp.tags["env"] = "prod"
+        m1 = sp.metrics.add()
+        m1.metric = ssf_pb2.SSFSample.HISTOGRAM
+        m1.name = f"svc.latency.{i % 256}"
+        m1.value = 1.0 + (i % 100)
+        m1.unit = "ms"
+        m1.sample_rate = 1.0
+        m2 = sp.metrics.add()
+        m2.metric = ssf_pb2.SSFSample.COUNTER
+        m2.name = f"svc.calls.{i % 256}"
+        m2.value = 1.0
+        m2.sample_rate = 1.0
+        return sp.SerializeToString()
+
+    n = 200_000
+    datagrams = [mk_span(i) for i in range(n)]
+    br = srv.native_bridge
+    t0 = time.perf_counter()
+    for data in datagrams:
+        br.handle_ssf(data)
+    decode_dt = time.perf_counter() - t0
+    assert srv.native_pump.drain(120)
+    total_dt = time.perf_counter() - t0
+    st = br.stats()
+    landed = sum(e.samples_processed for e in srv.engines)
+    srv.stop()
+    assert int(st["ssf_spans"]) == n, st
+    staged = 2 * n - int(st["ring_drops"])
+    assert landed == staged, (landed, staged)
+    _emit("c5c_ssf_native_spans_per_sec", n / total_dt, "spans/s",
+          100_000, spans=n, decode_stage_spans_per_sec=round(
+              n / decode_dt),
+          samples_landed=int(landed), ring_drops=int(st["ring_drops"]),
+          platform=_platform())
 
 
 def config6_e2e_udp_ingest(seconds: float = 8.0):
@@ -882,6 +979,7 @@ CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            3: config3_sets_1m_uniques, 4: config4_forward_merge_32_shards,
            5: config5_multichip_100k, 6: config6_e2e_udp_ingest,
            9: config5b_ssf_span_ingest, 10: config4b_multiseed_accuracy,
+           11: config5c_ssf_native_span_ingest,
            7: config7_mesh_global_merge, 8: config8_ingest_stages}
 
 

@@ -101,6 +101,21 @@ inline uint64_t fmix64(uint64_t h) {  // murmur3 finalizer (hashing.py fmix64)
   return h;
 }
 
+// fnv1a over name + type-name + joined tags — the one definition of
+// metric identity, shared by the statsd parse tail, the SSF sample
+// path, and the indicator timer (parity: utils/hashing.py
+// metric_digest)
+inline uint32_t metric_digest32(const uint8_t* name, size_t name_len,
+                                int mtype,
+                                const std::string& joined_tags) {
+  const char* tn = MTYPE_NAMES[mtype];
+  uint32_t h = fnv1a_32(name, name_len, FNV32_OFFSET);
+  h = fnv1a_32(reinterpret_cast<const uint8_t*>(tn), strlen(tn), h);
+  h = fnv1a_32(reinterpret_cast<const uint8_t*>(joined_tags.data()),
+               joined_tags.size(), h);
+  return h;
+}
+
 // ---------------------------------------------------------------- utf8
 // Strict UTF-8 validation: CPython's decoder only leaves bytes unchanged
 // (decode('utf-8','replace') then re-encode) when the input is strictly
@@ -347,13 +362,7 @@ ParseVerdict parse_line(
                           (*tags)[i].second);
   }
 
-  uint32_t h = fnv1a_32(name, name_len, FNV32_OFFSET);
-  const char* tn = MTYPE_NAMES[mtype];
-  h = fnv1a_32(reinterpret_cast<const uint8_t*>(tn), strlen(tn), h);
-  h = fnv1a_32(
-      reinterpret_cast<const uint8_t*>(m->joined_tags.data()),
-      m->joined_tags.size(), h);
-  m->digest = h;
+  m->digest = metric_digest32(name, name_len, mtype, m->joined_tags);
   return P_METRIC;
 }
 
@@ -484,6 +493,9 @@ struct Bridge {
 
   // set ONCE before readers start (no synchronization on the hot path)
   std::vector<std::string> tags_exclude;
+  // indicator-span duration timer name ("" = disabled); set before start
+  std::string indicator_timer;
+  std::atomic<uint64_t> ssf_spans{0}, ssf_fallbacks{0};
 
   std::mutex other_mu;
   std::deque<std::string> other;
@@ -608,6 +620,8 @@ void route_other(Bridge* br, const uint8_t* line, size_t len) {
   br->other.emplace_back(reinterpret_cast<const char*>(line), len);
 }
 
+void stage_parsed(Bridge* br, LocalStage* st, const ParsedMetric& m);
+
 void handle_line(Bridge* br, LocalStage* st, const uint8_t* line,
                  size_t len) {
   br->lines.fetch_add(1, std::memory_order_relaxed);
@@ -623,7 +637,12 @@ void handle_line(Bridge* br, LocalStage* st, const uint8_t* line,
     route_other(br, line, len);
     return;
   }
-  const ParsedMetric& m = st->m;
+  stage_parsed(br, st, st->m);
+}
+
+// Intern + stage one parsed metric through the thread's LocalStage —
+// the tail of handle_line, shared with the SSF span fast path.
+void stage_parsed(Bridge* br, LocalStage* st, const ParsedMetric& m) {
   uint64_t ep = br->intern_epoch.load(std::memory_order_acquire);
   if (st->cache_epoch != ep || st->cache_owner != br->instance_id) {
     for (auto& c : st->key_cache) c.clear();
@@ -690,6 +709,283 @@ void handle_buffer(Bridge* br, LocalStage* st, const uint8_t* data,
     if (ll > 0) handle_line(br, st, data + i, ll);
     i += ll + 1;
   }
+}
+
+// ---------------------------------------------------------------- ssf
+// Native span->metrics fast path: decode one SSF datagram (the
+// protobuf subset of ssf/protos/ssf.proto) and stage its embedded
+// samples straight into the rings — the C++ twin of
+// sinks/ssfmetrics.py (sample_to_metric + indicator_timer; parity:
+// sinks/ssfmetrics/metrics.go sym: metricExtractionSink). Spans the
+// fast path cannot express faithfully (STATUS samples, which become
+// service checks in Python) make the WHOLE datagram fall back to the
+// Python path — never a partial native landing.
+
+struct PbReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  bool tag(uint32_t* field, uint32_t* wt) {
+    if (p >= end) return false;
+    uint64_t t = varint();
+    if (!ok) return false;
+    *field = static_cast<uint32_t>(t >> 3);
+    *wt = static_cast<uint32_t>(t & 7);
+    return true;
+  }
+
+  bool bytes(const uint8_t** s, size_t* n) {
+    uint64_t len = varint();
+    if (!ok || len > static_cast<uint64_t>(end - p)) {
+      ok = false;
+      return false;
+    }
+    *s = p;
+    *n = static_cast<size_t>(len);
+    p += len;
+    return true;
+  }
+
+  float f32() {
+    if (end - p < 4) {
+      ok = false;
+      return 0.0f;
+    }
+    float v;
+    memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+
+  void skip(uint32_t wt) {
+    switch (wt) {
+      case 0: varint(); break;
+      case 1: p = (end - p >= 8) ? p + 8 : (ok = false, end); break;
+      case 2: {
+        const uint8_t* s;
+        size_t n;
+        bytes(&s, &n);
+        break;
+      }
+      case 5: p = (end - p >= 4) ? p + 4 : (ok = false, end); break;
+      default: ok = false;
+    }
+  }
+};
+
+// parse one map<string,string> entry {1: key, 2: value} into raw
+// (key, value) — kept raw so map semantics (last entry wins per key)
+// can be applied before formatting
+bool parse_tag_entry(const uint8_t* s, size_t n,
+                     std::pair<std::string, std::string>* out) {
+  PbReader r{s, s + n};
+  const uint8_t *k = nullptr, *v = nullptr;
+  size_t kn = 0, vn = 0;
+  uint32_t f, wt;
+  while (r.tag(&f, &wt)) {
+    if (f == 1 && wt == 2) {
+      if (!r.bytes(&k, &kn)) return false;
+    } else if (f == 2 && wt == 2) {
+      if (!r.bytes(&v, &vn)) return false;
+    } else {
+      r.skip(wt);
+    }
+    if (!r.ok) return false;
+  }
+  if (!r.ok) return false;
+  out->first.assign(reinterpret_cast<const char*>(k), kn);
+  out->second.assign(reinterpret_cast<const char*>(v), vn);
+  return true;
+}
+
+struct SsfSample {
+  uint64_t metric = 0;
+  std::string name, message, unit;
+  float value = 0.0f;
+  float rate = 0.0f;
+  uint64_t scope = 0;
+  std::vector<std::pair<std::string, std::string>> tags;  // raw k, v
+};
+
+bool parse_ssf_sample(const uint8_t* s, size_t n, SsfSample* out) {
+  PbReader r{s, s + n};
+  uint32_t f, wt;
+  while (r.tag(&f, &wt)) {
+    const uint8_t* b;
+    size_t bn;
+    switch (f) {
+      case 1: out->metric = r.varint(); break;                // Metric
+      case 2:                                                 // name
+        if (!r.bytes(&b, &bn)) return false;
+        out->name.assign(reinterpret_cast<const char*>(b), bn);
+        break;
+      case 3: out->value = r.f32(); break;                    // value
+      case 5:                                                 // message
+        if (!r.bytes(&b, &bn)) return false;
+        out->message.assign(reinterpret_cast<const char*>(b), bn);
+        break;
+      case 7: out->rate = r.f32(); break;                     // rate
+      case 8:                                                 // tags
+        if (!r.bytes(&b, &bn)) return false;
+        out->tags.emplace_back();
+        if (!parse_tag_entry(b, bn, &out->tags.back())) return false;
+        break;
+      case 9:                                                 // unit
+        if (!r.bytes(&b, &bn)) return false;
+        out->unit.assign(reinterpret_cast<const char*>(b), bn);
+        break;
+      case 10: out->scope = r.varint(); break;                // Scope
+      default: r.skip(wt);
+    }
+    if (!r.ok) return false;
+  }
+  return r.ok;
+}
+
+// time-unit scale to milliseconds (ssf/__init__.py TIME_UNITS; "\xc2\xb5s"
+// is UTF-8 "µs")
+bool time_unit_ms(const std::string& u, double* scale_ms) {
+  if (u == "ns") *scale_ms = 1e-6;
+  else if (u == "\xc2\xb5s" || u == "us") *scale_ms = 1e-3;
+  else if (u == "ms") *scale_ms = 1.0;
+  else if (u == "s") *scale_ms = 1e3;
+  else return false;
+  return true;
+}
+
+// Fill a ParsedMetric from one decoded sample; mirrors
+// sample_to_metric. Returns false when the sample is skipped (no name
+// / unknown type) — the Python twin returns None for those.
+bool sample_to_parsed(const SsfSample& s, ParsedMetric* m) {
+  if (s.name.empty()) return false;
+  switch (s.metric) {
+    case 0: m->mtype = MT_COUNTER; break;
+    case 1: m->mtype = MT_GAUGE; break;
+    case 2: m->mtype = MT_HISTOGRAM; break;
+    case 3: m->mtype = MT_SET; break;
+    default: return false;  // STATUS is pre-filtered; unknown skipped
+  }
+  m->value = s.value;
+  double scale_ms;
+  if (m->mtype == MT_HISTOGRAM && time_unit_ms(s.unit, &scale_ms)) {
+    m->mtype = MT_TIMER;
+    m->value = static_cast<double>(s.value) * scale_ms;
+  }
+  m->rate = (s.rate != 0.0f) ? s.rate : 1.0;
+  m->scope = (s.scope <= 2) ? static_cast<uint8_t>(s.scope)
+                            : static_cast<uint8_t>(SC_MIXED);
+  m->name = s.name;
+  if (m->mtype == MT_SET) m->member = s.message;
+  // proto3 map semantics: for duplicate keys on the wire, the LAST
+  // entry wins (what the Python decoder's dict does) — dedupe on the
+  // raw key before formatting, or the native and fallback paths would
+  // build different metric identities for the same datagram
+  std::vector<std::string> formatted;
+  formatted.reserve(s.tags.size());
+  for (size_t i = 0; i < s.tags.size(); i++) {
+    bool overwritten = false;
+    for (size_t j = i + 1; j < s.tags.size(); j++)
+      if (s.tags[j].first == s.tags[i].first) {
+        overwritten = true;
+        break;
+      }
+    if (overwritten) continue;
+    std::string f = s.tags[i].first;
+    if (!s.tags[i].second.empty()) {
+      f.push_back(':');
+      f.append(s.tags[i].second);
+    }
+    formatted.push_back(std::move(f));
+  }
+  // sorted, comma-joined — UTF-8 byte order equals code point order,
+  // so std::sort matches Python's sorted()
+  std::sort(formatted.begin(), formatted.end());
+  m->joined_tags.clear();
+  for (size_t i = 0; i < formatted.size(); i++) {
+    if (i) m->joined_tags.push_back(',');
+    m->joined_tags.append(formatted[i]);
+  }
+  m->digest = metric_digest32(
+      reinterpret_cast<const uint8_t*>(m->name.data()), m->name.size(),
+      m->mtype, m->joined_tags);
+  return true;
+}
+
+// Decode + stage one SSF datagram. Returns 1 when handled natively,
+// 0 when the caller must use the Python path (STATUS samples present),
+// -1 on malformed protobuf (counted; caller should count an ssf error).
+int handle_ssf(Bridge* br, LocalStage* st, const uint8_t* data,
+               size_t len) {
+  PbReader r{data, data + len};
+  std::vector<SsfSample> samples;
+  bool indicator = false, error = false;
+  int64_t start_ts = 0, end_ts = 0;
+  std::string service;
+  uint32_t f, wt;
+  while (r.tag(&f, &wt)) {
+    const uint8_t* b;
+    size_t bn;
+    switch (f) {
+      case 5: start_ts = static_cast<int64_t>(r.varint()); break;
+      case 6: end_ts = static_cast<int64_t>(r.varint()); break;
+      case 7: error = r.varint() != 0; break;
+      case 8:                                              // service
+        if (!r.bytes(&b, &bn)) return -1;
+        service.assign(reinterpret_cast<const char*>(b), bn);
+        break;
+      case 10: indicator = r.varint() != 0; break;
+      case 12:                                             // metrics
+        if (!r.bytes(&b, &bn)) return -1;
+        samples.emplace_back();
+        if (!parse_ssf_sample(b, bn, &samples.back())) return -1;
+        break;
+      default: r.skip(wt);
+    }
+    if (!r.ok) return -1;
+  }
+  if (!r.ok) return -1;
+  // STATUS samples become service checks in Python — whole-datagram
+  // fallback so one span never lands half-natively
+  for (const SsfSample& s : samples)
+    if (s.metric == 4) {
+      br->ssf_fallbacks.fetch_add(1, std::memory_order_relaxed);
+      return 0;
+    }
+  br->ssf_spans.fetch_add(1, std::memory_order_relaxed);
+  ParsedMetric m;
+  for (const SsfSample& s : samples)
+    if (sample_to_parsed(s, &m)) stage_parsed(br, st, m);
+  if (indicator && !br->indicator_timer.empty() && start_ts && end_ts) {
+    // indicator_timer(): duration timer tagged service/error
+    m.mtype = MT_TIMER;
+    m.value = static_cast<double>(end_ts >= start_ts ? end_ts - start_ts
+                                                     : 0) / 1e6;
+    m.rate = 1.0;
+    m.scope = SC_MIXED;
+    m.name = br->indicator_timer;
+    std::string etag = error ? "error:true" : "error:false";
+    std::string stag = "service:" + service;
+    m.joined_tags = etag < stag ? etag + "," + stag : stag + "," + etag;
+    m.digest = metric_digest32(
+        reinterpret_cast<const uint8_t*>(m.name.data()), m.name.size(),
+        m.mtype, m.joined_tags);
+    stage_parsed(br, st, m);
+  }
+  return 1;
 }
 
 void reader_loop(Bridge* br, int sock) {
@@ -763,6 +1059,24 @@ void vtpu_handle_packet(void* h, const uint8_t* data, int32_t len) {
   br->packets.fetch_add(1, std::memory_order_relaxed);
   handle_buffer(br, &st, data, static_cast<size_t>(len));
   st.flush(br);
+}
+
+// Decode one SSF span datagram and stage its embedded samples natively.
+// Returns 1 = handled, 0 = caller must use the Python span path for
+// this datagram, -1 = malformed protobuf.
+int32_t vtpu_handle_ssf(void* h, const uint8_t* data, int32_t len) {
+  Bridge* br = static_cast<Bridge*>(h);
+  thread_local LocalStage st;
+  int rc = handle_ssf(br, &st, data, static_cast<size_t>(len));
+  if (rc == 1) st.flush(br);
+  return rc;
+}
+
+// Configure the indicator-span duration timer (config key
+// indicator_span_timer_name). Must be called before readers start.
+void vtpu_set_indicator_timer(void* h, const char* name) {
+  Bridge* br = static_cast<Bridge*>(h);
+  br->indicator_timer = name ? name : "";
 }
 
 // Start n SO_REUSEPORT UDP reader threads on host:port. Returns bound
@@ -1010,6 +1324,8 @@ void vtpu_stats(void* h, uint64_t* out) {
   }
   out[5] = no_slot;
   out[6] = ring_drops;
+  out[9] = br->ssf_spans.load();
+  out[10] = br->ssf_fallbacks.load();
   std::lock_guard<std::mutex> g(br->other_mu);
   out[7] = br->other_drops;
   out[8] = br->other.size();

@@ -392,6 +392,291 @@ class TestPumpBufferAliasing:
             br.close()
 
 
+class TestNativeSSF:
+    """The C++ SSF span fast path (vtpu_handle_ssf) against its Python
+    twin (sinks/ssfmetrics.py sample_to_metric / indicator_timer)."""
+
+    def _bridge(self, **kw):
+        return native.NativeBridge(histo_slots=256, counter_slots=256,
+                                   gauge_slots=64, set_slots=64,
+                                   hll_precision=14, idle_ttl=4,
+                                   ring_capacity=65536, max_packet=8192,
+                                   **kw)
+
+    def test_ssf_parity_randomized(self):
+        """Random spans: every natively staged sample must agree with
+        sample_to_metric on key identity (name/type/tags/digest), bank,
+        value, and weight."""
+        from veneur_tpu.sinks.ssfmetrics import sample_to_metric
+        from veneur_tpu.ssf.protos import ssf_pb2
+
+        rng = random.Random(42)
+        br = self._bridge()
+        expected = []  # (type, name, joined_tags, value, weight|idx/rho)
+        spans = []
+        for i in range(50):
+            sp = ssf_pb2.SSFSpan()
+            sp.version = 1
+            for j in range(rng.randint(1, 4)):
+                s = sp.metrics.add()
+                s.metric = rng.choice([
+                    ssf_pb2.SSFSample.COUNTER, ssf_pb2.SSFSample.GAUGE,
+                    ssf_pb2.SSFSample.HISTOGRAM, ssf_pb2.SSFSample.SET])
+                s.name = f"m{rng.randint(0, 20)}"
+                s.value = round(rng.uniform(0.1, 500.0), 3)
+                if s.metric == ssf_pb2.SSFSample.SET:
+                    s.message = f"member-{rng.randint(0, 99)}-é"
+                if s.metric == ssf_pb2.SSFSample.HISTOGRAM \
+                        and rng.random() < 0.5:
+                    s.unit = rng.choice(["ns", "µs", "us", "ms",
+                                         "s", "bytes"])
+                if rng.random() < 0.5:
+                    s.sample_rate = rng.choice([0.1, 0.5, 1.0])
+                for t in range(rng.randint(0, 3)):
+                    s.tags[f"k{rng.randint(0, 5)}"] = \
+                        rng.choice(["", "v1", "v2", "ü"])
+                s.scope = rng.choice([0, 1, 2])
+                it = sample_to_metric(s)
+                if it is not None:
+                    expected.append(it)
+            spans.append(sp)
+        for sp in spans:
+            assert br.handle_ssf(sp.SerializeToString()) == 1
+        try:
+            # slots are per-bank: key records by (bank_index, slot)
+            keys = {(k[0], k[3]): k for k in br.drain_new_keys()}
+            bank_idx = {"histo": 0, "counter": 1, "gauge": 2, "set": 3}
+            # drain all rings, grouped per bank
+            staged = {b: [] for b in ("histo", "counter", "gauge", "set")}
+            bufs = tuple(np.zeros(4096, dt) for dt in
+                         (np.int32, np.float32, np.float32, np.int32))
+            for bank in staged:
+                n = br.poll(bank, *bufs)
+                for i in range(n):
+                    staged[bank].append((int(bufs[0][i]),
+                                         float(bufs[1][i]),
+                                         float(bufs[2][i]),
+                                         int(bufs[3][i])))
+            bank_of = {"counter": "counter", "gauge": "gauge",
+                       "timer": "histo", "histogram": "histo",
+                       "set": "set"}
+            # order within one ring is arrival order; expectations are
+            # in emission order per bank too
+            per_bank_exp = {b: [] for b in staged}
+            for it in expected:
+                per_bank_exp[bank_of[it.key.type]].append(it)
+            for bank, rows in staged.items():
+                exp = per_bank_exp[bank]
+                assert len(rows) == len(exp), (bank, len(rows), len(exp))
+                for (slot, a, b_, c), it in zip(rows, exp):
+                    rec = keys[(bank_idx[bank], slot)]
+                    assert rec[4] == it.key.name
+                    assert rec[5] == it.key.joined_tags
+                    assert native._MTYPE_NAMES[rec[1]] == it.key.type
+                    if bank == "set":
+                        h = hashing.set_member_hash(str(it.value))
+                        p = 14
+                        assert c == h >> (64 - p)
+                        rest = ((h << p) & 0xFFFFFFFFFFFFFFFF) \
+                            | ((1 << p) - 1)
+                        assert int(a) == 65 - rest.bit_length()
+                    else:
+                        assert a == pytest.approx(it.value, rel=1e-6)
+                        if bank in ("histo", "counter"):
+                            assert b_ == pytest.approx(
+                                1.0 / it.sample_rate, rel=1e-6)
+        finally:
+            br.close()
+
+    def test_ssf_duplicate_map_key_last_wins(self):
+        """proto3 map semantics: for a duplicate key on the wire the
+        LAST entry wins. The Python decoder's dict does this; the
+        native walker must agree or one datagram builds two different
+        metric identities depending on which path it rode."""
+        from veneur_tpu.sinks.ssfmetrics import sample_to_metric
+        from veneur_tpu.ssf.protos import ssf_pb2
+
+        def pb_len(field, payload: bytes) -> bytes:
+            return bytes([(field << 3) | 2, len(payload)]) + payload
+
+        def tag_entry(k: bytes, v: bytes) -> bytes:
+            return pb_len(8, pb_len(1, k) + pb_len(2, v))
+
+        sample = (bytes([1 << 3, 0])                    # metric=COUNTER
+                  + pb_len(2, b"dup.c")                 # name
+                  + tag_entry(b"k", b"v1")
+                  + tag_entry(b"k", b"v2")              # last wins
+                  + tag_entry(b"a", b"x"))
+        span = pb_len(12, sample)
+        # the Python decoder collapses to {k: v2, a: x}
+        py = ssf_pb2.SSFSpan.FromString(span)
+        it = sample_to_metric(py.metrics[0])
+        assert it.key.joined_tags == "a:x,k:v2"
+        br = self._bridge()
+        try:
+            assert br.handle_ssf(span) == 1
+            keys = br.drain_new_keys()
+            assert len(keys) == 1
+            assert keys[0][5] == it.key.joined_tags, keys[0]
+        finally:
+            br.close()
+
+    def test_ssf_status_fallback_and_malformed(self):
+        from veneur_tpu.ssf.protos import ssf_pb2
+        br = self._bridge()
+        try:
+            sp = ssf_pb2.SSFSpan()
+            s = sp.metrics.add()
+            s.metric = ssf_pb2.SSFSample.STATUS
+            s.name = "chk"
+            s.status = ssf_pb2.SSFSample.CRITICAL
+            m = sp.metrics.add()
+            m.metric = ssf_pb2.SSFSample.COUNTER
+            m.name = "c"
+            m.value = 1.0
+            # whole-datagram fallback: the counter must NOT have been
+            # staged natively (no partial landing)
+            assert br.handle_ssf(sp.SerializeToString()) == 0
+            assert br.stats()["samples"] == 0
+            assert br.stats()["ssf_fallbacks"] == 1
+            assert br.handle_ssf(b"\xff\xff\xff\xff\x01") == -1
+        finally:
+            br.close()
+
+    def test_ssf_indicator_timer(self):
+        from veneur_tpu.sinks.ssfmetrics import indicator_timer
+        from veneur_tpu.ssf.protos import ssf_pb2
+        br = self._bridge()
+        br.set_indicator_timer("veneur.indicator")
+        try:
+            sp = ssf_pb2.SSFSpan()
+            sp.indicator = True
+            sp.error = True
+            sp.service = "api"
+            sp.start_timestamp = 10**18
+            sp.end_timestamp = 10**18 + 12_345_678  # 12.345678 ms
+            assert br.handle_ssf(sp.SerializeToString()) == 1
+            want = indicator_timer(sp, "veneur.indicator")
+            keys = br.drain_new_keys()
+            assert len(keys) == 1
+            assert keys[0][4] == want.key.name
+            assert keys[0][5] == want.key.joined_tags
+            bufs = tuple(np.zeros(16, dt) for dt in
+                         (np.int32, np.float32, np.float32, np.int32))
+            n = br.poll("histo", *bufs)
+            assert n == 1
+            assert bufs[1][0] == pytest.approx(want.value, rel=1e-6)
+        finally:
+            br.close()
+
+    def test_native_ssf_stream_and_status_fallback(self):
+        """TCP-framed spans ride the native path; a STATUS-carrying
+        span falls back per-datagram to the Python pipeline and still
+        yields BOTH its embedded sample and the service check."""
+        import jax  # noqa: F401
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks.basic import BlackholeMetricSink
+        from veneur_tpu.ssf import framing
+        from veneur_tpu.ssf.protos import ssf_pb2
+
+        cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                     ssf_listen_addresses=["tcp://127.0.0.1:0"],
+                     interval="3600s", hostname="t", native_ingest=True,
+                     num_readers=1, tpu_histogram_slots=512,
+                     tpu_counter_slots=512, tpu_gauge_slots=64,
+                     tpu_set_slots=64)
+        srv = Server(cfg, sinks=[BlackholeMetricSink()], plugins=[])
+        srv.start()
+        try:
+            assert srv._native_ssf
+            port = srv._listen_socks[0].getsockname()[1]
+
+            def mk(i, status=False):
+                sp = ssf_pb2.SSFSpan()
+                sp.version = 1
+                m = sp.metrics.add()
+                m.metric = ssf_pb2.SSFSample.HISTOGRAM
+                m.name = "st.lat"
+                m.value = float(i)
+                m.unit = "ms"
+                if status:
+                    s = sp.metrics.add()
+                    s.metric = ssf_pb2.SSFSample.STATUS
+                    s.name = "st.check"
+                    s.status = 1
+                return sp
+
+            conn = socket.create_connection(("127.0.0.1", port))
+            for i in range(30):
+                conn.sendall(framing.write_ssf(mk(i)))
+            conn.sendall(framing.write_ssf(mk(99, status=True)))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and srv.spans_received < 31:
+                time.sleep(0.02)
+            assert srv.spans_received == 31
+            assert srv.drain(20)
+            assert srv.native_pump.drain(20)
+            res = srv.engines[0].flush(timestamp=1)
+            vals = {m.name: m.value for m in res.metrics}
+            assert vals["st.lat.count"] == 31.0
+            assert any(c.name == "st.check" and c.value == 1.0
+                       for c in res.status_metrics)
+            st = srv.native_bridge.stats()
+            assert st["ssf_spans"] == 30 and st["ssf_fallbacks"] == 1
+            conn.close()
+        finally:
+            srv.stop()
+
+    def test_native_ssf_server_end_to_end(self):
+        """Server with native ingest: SSF datagrams land via the C++
+        fast path (no Python span objects) and aggregate identically."""
+        import jax  # noqa: F401  (conftest pins cpu)
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks.basic import BlackholeMetricSink
+        from veneur_tpu.ssf.protos import ssf_pb2
+
+        cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                     ssf_listen_addresses=["udp://127.0.0.1:0"],
+                     interval="3600s", hostname="t", native_ingest=True,
+                     num_readers=1, tpu_histogram_slots=512,
+                     tpu_counter_slots=512, tpu_gauge_slots=64,
+                     tpu_set_slots=64)
+        srv = Server(cfg, sinks=[BlackholeMetricSink()], plugins=[])
+        srv.start()
+        try:
+            assert srv._native_ssf
+            port = srv._sockets[-1].getsockname()[1]
+            out = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            n = 40
+            for i in range(n):
+                sp = ssf_pb2.SSFSpan()
+                m1 = sp.metrics.add()
+                m1.metric = ssf_pb2.SSFSample.HISTOGRAM
+                m1.name = "nat.lat"
+                m1.value = float(i)
+                m1.unit = "ms"
+                m2 = sp.metrics.add()
+                m2.metric = ssf_pb2.SSFSample.COUNTER
+                m2.name = "nat.calls"
+                m2.value = 1.0
+                out.sendto(sp.SerializeToString(), ("127.0.0.1", port))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    srv.native_bridge.stats()["ssf_spans"] < n:
+                time.sleep(0.02)
+            assert srv.native_bridge.stats()["ssf_spans"] == n
+            assert srv.spans_received == n
+            assert srv.native_pump.drain(20)
+            res = srv.engines[0].flush(timestamp=1)
+            vals = {m.name: m.value for m in res.metrics}
+            assert vals["nat.calls"] == float(n)
+            assert vals["nat.lat.count"] == float(n)
+        finally:
+            srv.stop()
+
+
 class TestByteFuzz:
     """Raw byte-level fuzz: arbitrary byte soup and mutated valid lines.
     Neither parser may crash, and verdicts/values must stay conformant

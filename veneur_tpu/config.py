@@ -200,6 +200,15 @@ def _validate(cfg: Config) -> None:
         if not (0.0 < float(p) < 1.0):
             raise ValueError(
                 f"percentile {p} out of range (0, 1) exclusive")
+    if len(cfg.percentiles) > 8:
+        # the flush program's quantile interpolation unrolls over the
+        # percentile list (a deliberate lane-efficiency trade at the
+        # default 3-4): each extra percentile re-reads the full knot
+        # matrix, so very long lists scale the flush cost linearly
+        log.warning(
+            "%d percentiles configured: flush cost grows linearly with "
+            "the percentile count (the quantile program unrolls over "
+            "it); typical deployments use 3-4", len(cfg.percentiles))
     if cfg.interval_seconds <= 0:
         raise ValueError(f"interval must be positive: {cfg.interval!r}")
     unknown = [a for a in cfg.aggregates

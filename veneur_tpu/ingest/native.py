@@ -84,6 +84,14 @@ def load() -> ctypes.CDLL:
         lib.vtpu_destroy.argtypes = [ctypes.c_void_p]
         lib.vtpu_handle_packet.argtypes = [ctypes.c_void_p, u8p,
                                            ctypes.c_int32]
+        # c_char_p: ctypes passes the bytes object's buffer directly
+        # (read-only, zero-copy) — this call is per-datagram on the SSF
+        # hot path, where a bytearray+frombuffer wrap costs ~10us/call
+        lib.vtpu_handle_ssf.restype = ctypes.c_int32
+        lib.vtpu_handle_ssf.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int32]
+        lib.vtpu_set_indicator_timer.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_char_p]
         lib.vtpu_start_udp.restype = ctypes.c_int32
         lib.vtpu_start_udp.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                        ctypes.c_int32, ctypes.c_int32,
@@ -209,6 +217,18 @@ class NativeBridge:
             np.zeros(1, np.uint8)
         self._lib.vtpu_handle_packet(self._h, _u8(arr), len(data))
 
+    def handle_ssf(self, data: bytes) -> int:
+        """Decode one SSF span datagram and stage its embedded samples
+        natively (sinks/ssfmetrics.py's C++ twin). Returns 1 = handled,
+        0 = caller must run the Python span path for this datagram
+        (STATUS samples present), -1 = malformed protobuf."""
+        return int(self._lib.vtpu_handle_ssf(self._h, data, len(data)))
+
+    def set_indicator_timer(self, name: str) -> None:
+        """Enable the indicator-span duration timer
+        (indicator_span_timer_name). Call before readers start."""
+        self._lib.vtpu_set_indicator_timer(self._h, name.encode())
+
     def set_tags_exclude(self, names) -> None:
         """Install tags_exclude (config.go sym: Config.TagsExclude) in
         the C++ parser. Must be called BEFORE start_udp — the list is
@@ -312,12 +332,13 @@ class NativeBridge:
             _u8(ta), len(tb))
 
     def stats(self) -> dict:
-        out = np.zeros(9, np.uint64)
+        out = np.zeros(11, np.uint64)
         self._lib.vtpu_stats(
             self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
         keys = ("packets", "lines", "samples", "parse_errors",
                 "slow_routed", "drops_no_slot", "ring_drops",
-                "other_drops", "pending_other")
+                "other_drops", "pending_other", "ssf_spans",
+                "ssf_fallbacks")
         return dict(zip(keys, out.tolist()))
 
 

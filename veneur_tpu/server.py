@@ -185,6 +185,26 @@ class Server:
             maxsize=max(1, cfg.ssf_buffer_size))
         self.span_sinks = (span_sinks if span_sinks is not None
                            else self._span_sinks_from_config())
+        # Native SSF fast path: when the only span consumer is the
+        # ssfmetrics bridge, the C++ bridge decodes span datagrams and
+        # stages their embedded samples straight into the rings — the
+        # Python span pipeline (decode -> queue -> worker -> extract ->
+        # re-submit -> per-sample process) costs ~75us/span where the
+        # native path is a few us. Spans the fast path can't express
+        # (STATUS samples -> service checks) fall back per-datagram.
+        from .sinks.ssfmetrics import SSFMetricsSink
+        self._native_ssf = (
+            self.native_bridge is not None
+            and len(self.span_sinks) == 1
+            and type(self.span_sinks[0]) is SSFMetricsSink)
+        if self._native_ssf:
+            # the sink's configured timer name, not cfg's: a caller may
+            # construct the sink directly with its own name, and the
+            # fallback (Python) path would use that — both paths must
+            # derive the same indicator timer
+            timer_name = self.span_sinks[0]._timer_name
+            if timer_name:
+                self.native_bridge.set_indicator_timer(timer_name)
 
     # ------------- construction helpers -------------
 
@@ -657,11 +677,24 @@ class Server:
         from .ssf import framing
 
         max_len = self.cfg.trace_max_length_bytes
+        native_ssf = self._native_ssf
         while not self._stop.is_set():
             try:
                 data, _ = sock.recvfrom(max_len)
             except OSError:
                 break
+            if native_ssf:
+                rc = self.native_bridge.handle_ssf(data)
+                if rc == 1:
+                    # samples staged in the rings; the pump lands them
+                    with self._stats_lock:
+                        self.spans_received += 1
+                    continue
+                if rc < 0:
+                    with self._stats_lock:
+                        self.ssf_errors += 1
+                    continue
+                # rc == 0: STATUS samples present — Python path below
             try:
                 span = framing.parse_ssf_datagram(data)
             except framing.FramingError:
@@ -686,16 +719,28 @@ class Server:
         frame poisons only its own connection."""
         from .ssf import framing
 
+        native_ssf = self._native_ssf
         try:
             with conn:
                 while not self._stop.is_set():
                     try:
-                        span = framing.read_ssf(conn)
+                        payload = framing.read_ssf_frame(conn)
+                        if payload is None:
+                            return
+                        if native_ssf:
+                            rc = self.native_bridge.handle_ssf(payload)
+                            if rc == 1:
+                                with self._stats_lock:
+                                    self.spans_received += 1
+                                continue
+                            if rc < 0:
+                                with self._stats_lock:
+                                    self.ssf_errors += 1
+                                return
+                        span = framing.parse_ssf_datagram(payload)
                     except (framing.FramingError, EOFError, OSError):
                         with self._stats_lock:
                             self.ssf_errors += 1
-                        return
-                    if span is None:
                         return
                     self.handle_ssf_span(span)
         finally:

@@ -51,10 +51,11 @@ def _read_exact(read, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def read_ssf(stream) -> ssf_pb2.SSFSpan | None:
-    """Read one framed span (protocol.ReadSSF). Returns None on clean
-    EOF (closed between frames); raises FramingError on a corrupt frame
-    and EOFError on truncation mid-frame."""
+def read_ssf_frame(stream) -> bytes | None:
+    """Read one frame's raw protobuf payload (for consumers with their
+    own decoder, e.g. the native span fast path). Returns None on clean
+    EOF; raises FramingError on a corrupt frame and EOFError on
+    truncation mid-frame."""
     read = stream.recv if hasattr(stream, "recv") else stream.read
     first = read(1)
     if not first:
@@ -65,7 +66,16 @@ def read_ssf(stream) -> ssf_pb2.SSFSpan | None:
     if length > MAX_FRAME_LENGTH:
         raise FramingError(f"frame length {length} exceeds max "
                            f"{MAX_FRAME_LENGTH}")
-    payload = _read_exact(read, length)
+    return _read_exact(read, length)
+
+
+def read_ssf(stream) -> ssf_pb2.SSFSpan | None:
+    """Read one framed span (protocol.ReadSSF). Returns None on clean
+    EOF (closed between frames); raises FramingError on a corrupt frame
+    and EOFError on truncation mid-frame."""
+    payload = read_ssf_frame(stream)
+    if payload is None:
+        return None
     try:
         return ssf_pb2.SSFSpan.FromString(payload)
     except Exception as e:
