@@ -13,7 +13,11 @@ makes them unusably slow):
     make -C native tsan
     LD_PRELOAD=$(g++ -print-file-name=libtsan.so) \
     VENEUR_TPU_NATIVE_LIB=native/build/libvtpu_ingest_tsan.so \
-    TSAN_OPTIONS=exitcode=66 python native/tsan_stress.py
+    TSAN_OPTIONS="exitcode=66 suppressions=native/tsan.supp" \
+    python native/tsan_stress.py
+
+(the suppression covers only glibc's TLS-teardown false positive —
+see native/tsan.supp)
 
 Exit 0 + "tsan stress ok" and no "WARNING: ThreadSanitizer" output means
 a clean run; TSAN itself exits 66 on a detected race.
@@ -61,6 +65,56 @@ def main() -> int:
                 f"d{i % 41}:{i % 7}|ms\ng:{i}|g".encode())
             i += 1
 
+    # pre-built SSF datagrams (protobuf import is cheap; jax stays out)
+    from veneur_tpu.ssf.protos import ssf_pb2
+
+    def mk_ssf(i):
+        sp = ssf_pb2.SSFSpan()
+        sp.version = 1
+        sp.indicator = bool(i % 3 == 0)
+        sp.service = "tsan"
+        sp.start_timestamp = 10**18
+        sp.end_timestamp = 10**18 + i
+        m = sp.metrics.add()
+        m.metric = [ssf_pb2.SSFSample.COUNTER, ssf_pb2.SSFSample.GAUGE,
+                    ssf_pb2.SSFSample.HISTOGRAM,
+                    ssf_pb2.SSFSample.SET][i % 4]
+        m.name = f"s{i % 37}"
+        m.value = float(i % 13)
+        if m.metric == ssf_pb2.SSFSample.SET:
+            m.message = f"mem{i % 29}"
+        if i % 5 == 0:
+            m.tags["env"] = "prod"
+        return sp.SerializeToString()
+
+    ssf_datagrams = [mk_ssf(i) for i in range(128)]
+    # every 8th datagram carries a STATUS sample -> exercises the
+    # fallback (ssf_other) queue under concurrency
+    for i in range(0, 128, 8):
+        sp = ssf_pb2.SSFSpan()
+        s = sp.metrics.add()
+        s.metric = ssf_pb2.SSFSample.STATUS
+        s.name = "tsan.check"
+        s.status = 1
+        ssf_datagrams[i] = sp.SerializeToString()
+    bridges[0].set_indicator_timer("tsan.indicator")
+    ssf_port = bridges[0].start_ssf_udp("127.0.0.1", 0, n_readers=2)
+
+    def ssf_caller():
+        # the native SSF decode+stage path, concurrent with UDP
+        # readers, pollers, and interval ticks on the same bridge
+        i = 0
+        while not stop.is_set():
+            bridges[0].handle_ssf(ssf_datagrams[i % 128])
+            i += 1
+
+    def ssf_sender():
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        i = 0
+        while not stop.is_set():
+            s.sendto(ssf_datagrams[i % 128], ("127.0.0.1", ssf_port))
+            i += 1
+
     import numpy as np
 
     def pump(br):
@@ -74,6 +128,7 @@ def main() -> int:
                 polled += max(0, br.poll(bank, slots, a, b, c))
             br.drain_new_keys()
             br.drain_other()
+            br.drain_ssf_other()
             time.sleep(0.001)
         return polled
 
@@ -86,7 +141,7 @@ def main() -> int:
             time.sleep(0.05)
 
     threads = [threading.Thread(target=f, daemon=True) for f in (
-        sender, sender, direct_caller,
+        sender, sender, direct_caller, ssf_caller, ssf_sender,
         lambda: pump(bridges[0]), lambda: pump(bridges[1]),
         lambda: ticker(bridges[0]), lambda: ticker(bridges[1]))]
     for t in threads:

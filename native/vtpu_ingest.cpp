@@ -502,6 +502,17 @@ struct Bridge {
   size_t other_cap = 65536;
   uint64_t other_drops = 0;
 
+  // SSF datagrams the native fast path could not express (STATUS
+  // samples): raw bytes for the Python span pipeline, plus the native
+  // SSF listener's own counters/port
+  std::mutex ssf_other_mu;
+  std::deque<std::string> ssf_other;
+  size_t ssf_other_cap = 65536;
+  uint64_t ssf_other_drops = 0;
+  std::atomic<uint64_t> ssf_errors{0};
+  int ssf_bound_port = 0;
+  int ssf_max_dgram = 16384;
+
   std::atomic<uint64_t> packets{0}, lines{0}, samples{0}, parse_errors{0},
       slow_routed{0};
 
@@ -807,6 +818,10 @@ bool parse_tag_entry(const uint8_t* s, size_t n,
     if (!r.ok) return false;
   }
   if (!r.ok) return false;
+  // proto3 `string` fields must be valid UTF-8 — the Python decoder
+  // rejects the whole message otherwise, and the key records these
+  // bytes land in are strict-decoded downstream
+  if (!utf8_valid(k, kn) || !utf8_valid(v, vn)) return false;
   out->first.assign(reinterpret_cast<const char*>(k), kn);
   out->second.assign(reinterpret_cast<const char*>(v), vn);
   return true;
@@ -830,12 +845,12 @@ bool parse_ssf_sample(const uint8_t* s, size_t n, SsfSample* out) {
     switch (f) {
       case 1: out->metric = r.varint(); break;                // Metric
       case 2:                                                 // name
-        if (!r.bytes(&b, &bn)) return false;
+        if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
         out->name.assign(reinterpret_cast<const char*>(b), bn);
         break;
       case 3: out->value = r.f32(); break;                    // value
       case 5:                                                 // message
-        if (!r.bytes(&b, &bn)) return false;
+        if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
         out->message.assign(reinterpret_cast<const char*>(b), bn);
         break;
       case 7: out->rate = r.f32(); break;                     // rate
@@ -845,7 +860,7 @@ bool parse_ssf_sample(const uint8_t* s, size_t n, SsfSample* out) {
         if (!parse_tag_entry(b, bn, &out->tags.back())) return false;
         break;
       case 9:                                                 // unit
-        if (!r.bytes(&b, &bn)) return false;
+        if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
         out->unit.assign(reinterpret_cast<const char*>(b), bn);
         break;
       case 10: out->scope = r.varint(); break;                // Scope
@@ -944,7 +959,7 @@ int handle_ssf(Bridge* br, LocalStage* st, const uint8_t* data,
       case 6: end_ts = static_cast<int64_t>(r.varint()); break;
       case 7: error = r.varint() != 0; break;
       case 8:                                              // service
-        if (!r.bytes(&b, &bn)) return -1;
+        if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return -1;
         service.assign(reinterpret_cast<const char*>(b), bn);
         break;
       case 10: indicator = r.varint() != 0; break;
@@ -988,29 +1003,73 @@ int handle_ssf(Bridge* br, LocalStage* st, const uint8_t* data,
   return 1;
 }
 
-void reader_loop(Bridge* br, int sock) {
-  constexpr int VLEN = 64;
-  LocalStage st;
-  std::vector<std::vector<uint8_t>> bufs(VLEN);
-  std::vector<mmsghdr> msgs(VLEN);
-  std::vector<iovec> iovs(VLEN);
-  for (int i = 0; i < VLEN; i++) {
-    bufs[i].resize(br->max_packet);
-    iovs[i].iov_base = bufs[i].data();
-    iovs[i].iov_len = bufs[i].size();
-    memset(&msgs[i], 0, sizeof(mmsghdr));
-    msgs[i].msg_hdr.msg_iov = &iovs[i];
-    msgs[i].msg_hdr.msg_iovlen = 1;
+// recvmmsg burst machinery shared by the statsd and SSF reader loops.
+struct RecvBatch {
+  static constexpr int VLEN = 64;
+  std::vector<std::vector<uint8_t>> bufs;
+  std::vector<mmsghdr> msgs;
+  std::vector<iovec> iovs;
+
+  explicit RecvBatch(size_t max_dgram)
+      : bufs(VLEN), msgs(VLEN), iovs(VLEN) {
+    for (int i = 0; i < VLEN; i++) {
+      bufs[i].resize(max_dgram);
+      iovs[i].iov_base = bufs[i].data();
+      iovs[i].iov_len = bufs[i].size();
+      memset(&msgs[i], 0, sizeof(mmsghdr));
+      msgs[i].msg_hdr.msg_iov = &iovs[i];
+      msgs[i].msg_hdr.msg_iovlen = 1;
+    }
   }
+};
+
+void reader_loop(Bridge* br, int sock) {
+  LocalStage st;
+  RecvBatch rb(br->max_packet);
   pollfd pfd{sock, POLLIN, 0};
   while (!br->stop.load(std::memory_order_relaxed)) {
     int pr = poll(&pfd, 1, 100);
     if (pr <= 0) continue;
-    int n = recvmmsg(sock, msgs.data(), VLEN, MSG_DONTWAIT, nullptr);
+    int n = recvmmsg(sock, rb.msgs.data(), RecvBatch::VLEN, MSG_DONTWAIT,
+                     nullptr);
     if (n <= 0) continue;
     br->packets.fetch_add(n, std::memory_order_relaxed);
     for (int i = 0; i < n; i++)
-      handle_buffer(br, &st, bufs[i].data(), msgs[i].msg_len);
+      handle_buffer(br, &st, rb.bufs[i].data(), rb.msgs[i].msg_len);
+    st.flush(br);
+  }
+}
+
+void route_ssf_other(Bridge* br, const uint8_t* data, size_t len) {
+  std::lock_guard<std::mutex> g(br->ssf_other_mu);
+  if (br->ssf_other.size() >= br->ssf_other_cap) {
+    br->ssf_other_drops++;
+    return;
+  }
+  br->ssf_other.emplace_back(reinterpret_cast<const char*>(data), len);
+}
+
+// The SSF span listener: one datagram = one SSFSpan protobuf, decoded
+// and staged natively; fallback datagrams queue for the Python span
+// pipeline (Server.ReadSSFPacketSocket's C++ twin).
+void ssf_reader_loop(Bridge* br, int sock) {
+  LocalStage st;
+  RecvBatch rb(br->ssf_max_dgram);
+  pollfd pfd{sock, POLLIN, 0};
+  while (!br->stop.load(std::memory_order_relaxed)) {
+    int pr = poll(&pfd, 1, 100);
+    if (pr <= 0) continue;
+    int n = recvmmsg(sock, rb.msgs.data(), RecvBatch::VLEN, MSG_DONTWAIT,
+                     nullptr);
+    if (n <= 0) continue;
+    br->packets.fetch_add(n, std::memory_order_relaxed);
+    for (int i = 0; i < n; i++) {
+      int rc = handle_ssf(br, &st, rb.bufs[i].data(), rb.msgs[i].msg_len);
+      if (rc == 0)
+        route_ssf_other(br, rb.bufs[i].data(), rb.msgs[i].msg_len);
+      else if (rc < 0)
+        br->ssf_errors.fetch_add(1, std::memory_order_relaxed);
+    }
     st.flush(br);
   }
 }
@@ -1081,9 +1140,10 @@ void vtpu_set_indicator_timer(void* h, const char* name) {
 
 // Start n SO_REUSEPORT UDP reader threads on host:port. Returns bound
 // port (useful with port 0) or -errno.
-int32_t vtpu_start_udp(void* h, const char* host, int32_t port,
-                       int32_t n_readers, int32_t rcvbuf) {
-  Bridge* br = static_cast<Bridge*>(h);
+static int32_t open_udp_readers(Bridge* br, const char* host,
+                                int32_t port, int32_t n_readers,
+                                int32_t rcvbuf,
+                                void (*loop)(Bridge*, int)) {
   bool v6 = strchr(host, ':') != nullptr;
   int bound = -1;
   for (int r = 0; r < n_readers; r++) {
@@ -1132,10 +1192,51 @@ int32_t vtpu_start_udp(void* h, const char* host, int32_t port,
       return -e;
     }
     br->socks.push_back(fd);
-    br->readers.emplace_back(reader_loop, br, fd);
+    br->readers.emplace_back(loop, br, fd);
   }
-  br->bound_port = bound;
   return bound;
+}
+
+int32_t vtpu_start_udp(void* h, const char* host, int32_t port,
+                       int32_t n_readers, int32_t rcvbuf) {
+  Bridge* br = static_cast<Bridge*>(h);
+  int32_t bound = open_udp_readers(br, host, port, n_readers, rcvbuf,
+                                   reader_loop);
+  if (bound >= 0) br->bound_port = bound;
+  return bound;
+}
+
+// Start the native SSF span listener (one datagram = one SSFSpan).
+// max_dgram sizes the receive buffers (trace_max_length_bytes).
+int32_t vtpu_start_ssf_udp(void* h, const char* host, int32_t port,
+                           int32_t n_readers, int32_t rcvbuf,
+                           int32_t max_dgram) {
+  Bridge* br = static_cast<Bridge*>(h);
+  if (max_dgram > 0) br->ssf_max_dgram = max_dgram;
+  int32_t bound = open_udp_readers(br, host, port, n_readers, rcvbuf,
+                                   ssf_reader_loop);
+  if (bound >= 0) br->ssf_bound_port = bound;
+  return bound;
+}
+
+// Drain fallback SSF datagrams (STATUS-carrying spans) as u32le
+// length-prefixed records for the Python span pipeline.
+int32_t vtpu_drain_ssf_other(void* h, uint8_t* buf, int32_t buf_len) {
+  Bridge* br = static_cast<Bridge*>(h);
+  std::lock_guard<std::mutex> g(br->ssf_other_mu);
+  int32_t off = 0;
+  while (!br->ssf_other.empty()) {
+    const std::string& s = br->ssf_other.front();
+    int32_t need = 4 + static_cast<int32_t>(s.size());
+    if (off + need > buf_len) break;
+    uint32_t sl = static_cast<uint32_t>(s.size());
+    memcpy(buf + off, &sl, 4);
+    off += 4;
+    memcpy(buf + off, s.data(), sl);
+    off += sl;
+    br->ssf_other.pop_front();
+  }
+  return off;
 }
 
 void vtpu_stop(void* h) {
@@ -1326,6 +1427,12 @@ void vtpu_stats(void* h, uint64_t* out) {
   out[6] = ring_drops;
   out[9] = br->ssf_spans.load();
   out[10] = br->ssf_fallbacks.load();
+  out[11] = br->ssf_errors.load();
+  {
+    std::lock_guard<std::mutex> sg(br->ssf_other_mu);
+    out[12] = br->ssf_other_drops;
+    out[13] = br->ssf_other.size();
+  }
   std::lock_guard<std::mutex> g(br->other_mu);
   out[7] = br->other_drops;
   out[8] = br->other.size();
@@ -1401,6 +1508,10 @@ double vtpu_bench_parse(const uint8_t* data, int32_t len, int32_t iters) {
 
 int32_t vtpu_bound_port(void* h) {
   return static_cast<Bridge*>(h)->bound_port;
+}
+
+int32_t vtpu_ssf_bound_port(void* h) {
+  return static_cast<Bridge*>(h)->ssf_bound_port;
 }
 
 }  // extern "C"

@@ -521,6 +521,26 @@ class TestNativeSSF:
         finally:
             br.close()
 
+    def test_ssf_invalid_utf8_rejected(self):
+        """proto3 string fields must be valid UTF-8: the Python decoder
+        rejects the whole message, so the native walker must too — and
+        must NOT stage bytes that would later kill the pump when the
+        key record is strict-decoded (r5 review find)."""
+        def pb_len(field, payload: bytes) -> bytes:
+            return bytes([(field << 3) | 2, len(payload)]) + payload
+
+        bad_name = (bytes([1 << 3, 0]) + pb_len(2, b"\xff\xfe"))
+        bad_tag = (bytes([1 << 3, 0]) + pb_len(2, b"ok")
+                   + pb_len(8, pb_len(1, b"k") + pb_len(2, b"\xc3\x28")))
+        br = self._bridge()
+        try:
+            for sample in (bad_name, bad_tag):
+                assert br.handle_ssf(pb_len(12, sample)) == -1
+            assert br.stats()["samples"] == 0
+            assert br.drain_new_keys() == []
+        finally:
+            br.close()
+
     def test_ssf_status_fallback_and_malformed(self):
         from veneur_tpu.ssf.protos import ssf_pb2
         br = self._bridge()
@@ -611,10 +631,16 @@ class TestNativeSSF:
             for i in range(30):
                 conn.sendall(framing.write_ssf(mk(i)))
             conn.sendall(framing.write_ssf(mk(99, status=True)))
+
+            # native spans count in the bridge; only the Python-path
+            # fallback increments spans_received (no double count)
+            def total():
+                return (srv.native_bridge.stats()["ssf_spans"]
+                        + srv.spans_received)
             deadline = time.monotonic() + 10
-            while time.monotonic() < deadline and srv.spans_received < 31:
+            while time.monotonic() < deadline and total() < 31:
                 time.sleep(0.02)
-            assert srv.spans_received == 31
+            assert total() == 31 and srv.spans_received == 1
             assert srv.drain(20)
             assert srv.native_pump.drain(20)
             res = srv.engines[0].flush(timestamp=1)
@@ -647,7 +673,10 @@ class TestNativeSSF:
         srv.start()
         try:
             assert srv._native_ssf
-            port = srv._sockets[-1].getsockname()[1]
+            # the native C++ listener owns the SSF socket; no Python
+            # thread or socket object exists for it
+            port = srv.ssf_native_port
+            assert port
             out = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
             n = 40
             for i in range(n):
@@ -667,12 +696,60 @@ class TestNativeSSF:
                     srv.native_bridge.stats()["ssf_spans"] < n:
                 time.sleep(0.02)
             assert srv.native_bridge.stats()["ssf_spans"] == n
-            assert srv.spans_received == n
             assert srv.native_pump.drain(20)
             res = srv.engines[0].flush(timestamp=1)
             vals = {m.name: m.value for m in res.metrics}
             assert vals["nat.calls"] == float(n)
             assert vals["nat.lat.count"] == float(n)
+        finally:
+            srv.stop()
+
+    def test_native_ssf_listener_status_fallback(self):
+        """A STATUS-carrying datagram hitting the C++ listener rides
+        the ssf_other queue back through the pump into the Python span
+        pipeline: the service check must surface AND the embedded
+        sample must not be lost or double-landed."""
+        import jax  # noqa: F401
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks.basic import BlackholeMetricSink
+        from veneur_tpu.ssf.protos import ssf_pb2
+
+        cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                     ssf_listen_addresses=["udp://127.0.0.1:0"],
+                     interval="3600s", hostname="t", native_ingest=True,
+                     num_readers=1, tpu_histogram_slots=256,
+                     tpu_counter_slots=256, tpu_gauge_slots=64,
+                     tpu_set_slots=64)
+        srv = Server(cfg, sinks=[BlackholeMetricSink()], plugins=[])
+        srv.start()
+        try:
+            out = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sp = ssf_pb2.SSFSpan()
+            m = sp.metrics.add()
+            m.metric = ssf_pb2.SSFSample.COUNTER
+            m.name = "fb.c"
+            m.value = 3.0
+            s = sp.metrics.add()
+            s.metric = ssf_pb2.SSFSample.STATUS
+            s.name = "fb.check"
+            s.status = 2
+            out.sendto(sp.SerializeToString(),
+                       ("127.0.0.1", srv.ssf_native_port))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    srv.spans_received < 1:
+                srv.native_pump.pump_once()
+                time.sleep(0.02)
+            assert srv.spans_received == 1        # via the Python path
+            assert srv.drain(20)
+            res = srv.engines[0].flush(timestamp=1)
+            vals = {x.name: x.value for x in res.metrics}
+            assert vals["fb.c"] == 3.0
+            assert any(c.name == "fb.check" and c.value == 2.0
+                       for c in res.status_metrics)
+            st = srv.native_bridge.stats()
+            assert st["ssf_fallbacks"] == 1 and st["ssf_spans"] == 0
         finally:
             srv.stop()
 

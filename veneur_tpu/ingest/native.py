@@ -96,6 +96,16 @@ def load() -> ctypes.CDLL:
         lib.vtpu_start_udp.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                        ctypes.c_int32, ctypes.c_int32,
                                        ctypes.c_int32]
+        lib.vtpu_start_ssf_udp.restype = ctypes.c_int32
+        lib.vtpu_start_ssf_udp.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p,
+                                           ctypes.c_int32, ctypes.c_int32,
+                                           ctypes.c_int32, ctypes.c_int32]
+        lib.vtpu_drain_ssf_other.restype = ctypes.c_int32
+        lib.vtpu_drain_ssf_other.argtypes = [ctypes.c_void_p, u8p,
+                                             ctypes.c_int32]
+        lib.vtpu_ssf_bound_port.restype = ctypes.c_int32
+        lib.vtpu_ssf_bound_port.argtypes = [ctypes.c_void_p]
         lib.vtpu_stop.argtypes = [ctypes.c_void_p]
         lib.vtpu_poll.restype = ctypes.c_int32
         lib.vtpu_poll.argtypes = [ctypes.c_void_p, ctypes.c_int32,
@@ -246,6 +256,35 @@ class NativeBridge:
             raise OSError(-rc, os.strerror(-rc))
         return rc
 
+    def start_ssf_udp(self, host: str, port: int, n_readers: int,
+                      rcvbuf: int = 0, max_dgram: int = 16384) -> int:
+        """Start native SSF span readers (one datagram = one SSFSpan):
+        recvmmsg + decode + ring staging in C++; fallback datagrams
+        queue for drain_ssf_other. Returns the bound port."""
+        rc = self._lib.vtpu_start_ssf_udp(
+            self._h, host.encode(), port, n_readers, rcvbuf, max_dgram)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return rc
+
+    def drain_ssf_other(self) -> list:
+        """Fallback SSF datagrams (STATUS-carrying spans) for the
+        Python span pipeline, as raw protobuf bytes."""
+        out = []
+        while True:
+            n = self._lib.vtpu_drain_ssf_other(
+                self._h, _u8(self._other_buf), len(self._other_buf))
+            if n <= 0:
+                break
+            b = self._other_buf[:n].tobytes()
+            off = 0
+            while off < n:
+                (ln,) = struct.unpack_from("<I", b, off)
+                off += 4
+                out.append(b[off:off + ln])
+                off += ln
+        return out
+
     def stop(self):
         self._lib.vtpu_stop(self._h)
 
@@ -332,13 +371,14 @@ class NativeBridge:
             _u8(ta), len(tb))
 
     def stats(self) -> dict:
-        out = np.zeros(11, np.uint64)
+        out = np.zeros(14, np.uint64)
         self._lib.vtpu_stats(
             self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
         keys = ("packets", "lines", "samples", "parse_errors",
                 "slow_routed", "drops_no_slot", "ring_drops",
                 "other_drops", "pending_other", "ssf_spans",
-                "ssf_fallbacks")
+                "ssf_fallbacks", "ssf_errors", "ssf_other_drops",
+                "pending_ssf_other")
         return dict(zip(keys, out.tolist()))
 
 
@@ -433,11 +473,15 @@ class NativePump:
     """
 
     def __init__(self, bridge: NativeBridge, engine, views: dict,
-                 slow_path, batch: int = 8192, idle_sleep: float = 0.002):
+                 slow_path, batch: int = 8192, idle_sleep: float = 0.002,
+                 ssf_slow_path=None):
         self.bridge = bridge
         self.engine = engine
         self.views = views
         self.slow_path = slow_path
+        # raw SSF datagrams the native listener could not express
+        # (STATUS samples); routed to the Python span pipeline
+        self.ssf_slow_path = ssf_slow_path
         self.batch = batch
         self.idle_sleep = idle_sleep
         self._stop = threading.Event()
@@ -463,9 +507,18 @@ class NativePump:
             self._thread.join(timeout=5)
 
     def _run(self):
+        import logging
         import time
         while not self._stop.is_set():
-            moved = self.pump_once()
+            try:
+                moved = self.pump_once()
+            except Exception:
+                # a dead pump silently halts ALL aggregation (rings
+                # fill, every sample drops); degrade loudly instead
+                logging.getLogger(__name__).exception(
+                    "pump cycle failed; retrying")
+                time.sleep(0.1)
+                continue
             if moved == 0:
                 time.sleep(self.idle_sleep)
 
@@ -478,6 +531,10 @@ class NativePump:
             for line in self.bridge.drain_other():
                 self.slow_path(line)
                 moved += 1
+            if self.ssf_slow_path is not None:
+                for payload in self.bridge.drain_ssf_other():
+                    self.ssf_slow_path(payload)
+                    moved += 1
             return moved
 
     def drain(self, timeout: float = 10.0) -> bool:
@@ -487,7 +544,9 @@ class NativePump:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             moved = self.pump_once()
-            if moved == 0 and self.bridge.stats()["pending_other"] == 0:
+            st = self.bridge.stats()
+            if moved == 0 and st["pending_other"] == 0 \
+                    and st["pending_ssf_other"] == 0:
                 return True
         return False
 

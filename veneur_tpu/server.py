@@ -150,6 +150,7 @@ class Server:
         # the server traces itself through its own ingest path.
         self.trace_client = None
         self._ssf_udp_sock = None
+        self.ssf_native_port = None   # set by the native SSF listener
         self._sentry = None
         if cfg.sentry_dsn:
             from .utils.sentry import SentryClient
@@ -245,9 +246,22 @@ class Server:
                 return
             self._route_metric(item)
 
+        def ssf_slow_path(payload: bytes):
+            """SSF datagrams the native listener routed back (STATUS
+            samples -> service checks need Python semantics)."""
+            from .ssf import framing
+            try:
+                span = framing.parse_ssf_datagram(payload)
+            except framing.FramingError:
+                with self._stats_lock:
+                    self.ssf_errors += 1
+                return
+            self.handle_ssf_span(span)
+
         self.native_pump = NativePump(
             self.native_bridge, eng, views, slow_path,
-            batch=self.cfg.native_pump_batch)
+            batch=self.cfg.native_pump_batch,
+            ssf_slow_path=ssf_slow_path)
 
     def _sinks_from_config(self) -> list[MetricSink]:
         out: list[MetricSink] = []
@@ -366,10 +380,16 @@ class Server:
             self._start_statsd_listener(addr)
         for addr in self.cfg.ssf_listen_addresses:
             self._start_ssf_listener(addr)
-        if self.trace_client is None and self._ssf_udp_sock is not None:
-            from . import trace
-            port = self._ssf_udp_sock.getsockname()[1]
-            self.trace_client = trace.Client(f"udp://127.0.0.1:{port}")
+        if self.trace_client is None:
+            trace_port = None
+            if self._ssf_udp_sock is not None:
+                trace_port = self._ssf_udp_sock.getsockname()[1]
+            elif getattr(self, "ssf_native_port", None):
+                trace_port = self.ssf_native_port  # native SSF listener
+            if trace_port is not None:
+                from . import trace
+                self.trace_client = trace.Client(
+                    f"udp://127.0.0.1:{trace_port}")
         if self.cfg.enable_profiling:
             self._start_profiling()
         for addr in self.cfg.grpc_listen_addresses:
@@ -642,6 +662,17 @@ class Server:
         scheme, _, rest = addr.partition("://")
         if scheme in ("udp", "udp4", "udp6"):
             family, bind_addr = self._resolve_inet(scheme, rest)
+            if self._native_ssf and family != socket.AF_INET6:
+                # C++ SSF readers: recvmmsg + native decode + ring
+                # staging; no Python thread owns this socket. Fallback
+                # datagrams come back through the pump's ssf_slow_path.
+                self.ssf_native_port = self.native_bridge.start_ssf_udp(
+                    bind_addr[0], bind_addr[1],
+                    n_readers=max(1, self.cfg.num_readers),
+                    max_dgram=self.cfg.trace_max_length_bytes)
+                log.info("native SSF listener on udp://%s:%d",
+                         bind_addr[0], self.ssf_native_port)
+                return
             sock = socket.socket(family, socket.SOCK_DGRAM)
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             sock.bind(bind_addr)
@@ -686,9 +717,10 @@ class Server:
             if native_ssf:
                 rc = self.native_bridge.handle_ssf(data)
                 if rc == 1:
-                    # samples staged in the rings; the pump lands them
-                    with self._stats_lock:
-                        self.spans_received += 1
+                    # samples staged in the rings; the pump lands them.
+                    # Counted by the bridge's ssf_spans (folded into
+                    # telemetry) — NOT spans_received, which would
+                    # double-report the same span.
                     continue
                 if rc < 0:
                     with self._stats_lock:
@@ -730,8 +762,7 @@ class Server:
                         if native_ssf:
                             rc = self.native_bridge.handle_ssf(payload)
                             if rc == 1:
-                                with self._stats_lock:
-                                    self.spans_received += 1
+                                # counted via the bridge's ssf_spans
                                 continue
                             if rc < 0:
                                 with self._stats_lock:
@@ -1030,6 +1061,11 @@ class Server:
                 last.get("parse_errors", 0))
             drops += (int(st["ring_drops"])
                       - int(last.get("ring_drops", 0)))
+            # natively-decoded spans + their decode errors (fallback
+            # datagrams re-enter the Python path and are counted there)
+            spans += int(st["ssf_spans"]) - int(last.get("ssf_spans", 0))
+            sserrs += (int(st["ssf_errors"])
+                       - int(last.get("ssf_errors", 0)))
             if eng_stats is not None:
                 eng_stats["dropped_no_slot"] = (
                     int(st["drops_no_slot"])
