@@ -1,0 +1,55 @@
+"""Scatter/segmented-batch helper tests (ops/scatter.py).
+
+The helpers are exercised end-to-end through the t-digest and engine
+tests; these pin the packed-key sort fast path against the stable
+argsort fallback directly, including out-of-contract inputs.
+"""
+
+def test_sort_by_slot_packed_matches_argsort():
+    """The packed single-key sort (num_slots given, bits fit) must be
+    byte-identical to the stable-argsort fallback, including padding
+    placement and stability, across shapes that do and don't fit."""
+    import numpy as np
+    from veneur_tpu.ops import scatter
+
+    rng = np.random.default_rng(3)
+    for n, k in ((1, 1), (7, 4), (256, 31), (8192, 4096),
+                 (32768, 1 << 15), (512, 1 << 28)):  # last: no fit
+        slots = rng.integers(-1, k, n).astype(np.int32)
+        vals = rng.normal(size=n).astype(np.float32)
+        wts = rng.uniform(1, 2, n).astype(np.float32)
+        ref = scatter.sort_by_slot(slots, vals, wts)
+        got = scatter.sort_by_slot(slots, vals, wts, num_slots=k)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sort_by_slot_packed_out_of_range_ids_isolated():
+    """Out-of-contract slot ids (>= num_slots, incl. huge values that
+    would overflow the packed shift) must never interleave into a
+    valid slot's run — they sort into the tail with the padding and
+    downstream mode='drop' scatters discard them. The valid prefix
+    must be identical to the fallback path's."""
+    import numpy as np
+    from veneur_tpu.ops import scatter
+
+    rng = np.random.default_rng(5)
+    n, k = 4096, 256
+    slots = rng.integers(-1, k, n).astype(np.int32)
+    oob = rng.choice(n, 64, replace=False)
+    slots[oob] = np.asarray([k, k + 1, 131077, 2**30] * 16, np.int32)
+    vals = np.arange(n, dtype=np.float32)
+    ref = scatter.sort_by_slot(slots, vals)
+    got = scatter.sort_by_slot(slots, vals, num_slots=k)
+    rs, rv = np.asarray(ref[0]), np.asarray(ref[1])
+    gs, gv = np.asarray(got[0]), np.asarray(got[1])
+    valid_ref = (rs >= 0) & (rs < k)
+    valid_got = (gs >= 0) & (gs < k)
+    # the in-contract region is identical (same stable order)
+    np.testing.assert_array_equal(rs[valid_ref], gs[valid_got])
+    np.testing.assert_array_equal(rv[valid_ref], gv[valid_got])
+    # the valid region is a contiguous prefix in the packed path
+    assert valid_got[:valid_got.sum()].all()
+    # the dropped tail carries the same multiset either way
+    assert sorted(rv[~valid_ref].tolist()) == sorted(
+        gv[~valid_got].tolist())

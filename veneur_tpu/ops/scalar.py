@@ -107,7 +107,7 @@ def gauge_set(bank: GaugeBank, slots, values, seqs) -> GaugeBank:
     forwarded merges the stored seq arbitrates.
     """
     K = bank.num_slots
-    s, v, q = scatter.sort_by_slot(slots, values, seqs)
+    s, v, q = scatter.sort_by_slot(slots, values, seqs, num_slots=K)
     last = scatter.run_lasts(s) & (s >= 0)  # stable sort => last == max seq
     row = jnp.where(last, s, K)
     new_seq = bank.seq.at[row].max(q, mode="drop")

@@ -242,7 +242,7 @@ def _add_batch_impl(bank: TDigestBank, slots, values, weights,
     K = bank.num_slots
     B = bank.buf_size
 
-    s, v, w = scatter.sort_by_slot(slots, values, weights)
+    s, v, w = scatter.sort_by_slot(slots, values, weights, num_slots=K)
     rank = scatter.run_ranks(s)
     valid = s >= 0
     sd = jnp.where(valid, s, K)  # OOB -> dropped by mode="drop"
@@ -323,7 +323,7 @@ def merge_centroids(bank: TDigestBank, slots, means, weights) -> TDigestBank:
     # buffer positions and corrupt later writes), so mask them to slot -1
     # before the sort.
     slots = jnp.where(weights > 0, slots, -1)
-    s, v, w = scatter.sort_by_slot(slots, means, weights)
+    s, v, w = scatter.sort_by_slot(slots, means, weights, num_slots=K)
     rank = scatter.run_ranks(s)
     valid = (s >= 0) & (w > 0)
     pos = bank.buf_n[jnp.where(valid, s, 0)] + rank
