@@ -266,12 +266,10 @@ def _add_batch_impl(bank: TDigestBank, slots, values, weights,
         vsum_lo=vsum_lo, count_lo=count_lo, recip_lo=recip_lo,
     )
 
-    def cond(state):
-        _, written = state
-        return jnp.any(valid & ~written)
-
-    def body(state):
-        bank, written = state
+    def write_pass(bank, written):
+        """One buffer-write pass: land every not-yet-written sample
+        whose position fits its slot's buffer. Returns the updated
+        bank and written mask."""
         # Rank among the not-yet-written samples of each slot: ranks are
         # consumed in order, so subtracting the per-slot written count
         # re-bases them.
@@ -286,7 +284,15 @@ def _add_batch_impl(bank: TDigestBank, slots, values, weights,
         wrote = scatter.segment_count(s, can, K)
         bank = bank._replace(buf_value=new_bv, buf_weight=new_bw,
                              buf_n=bank.buf_n + wrote)
-        written = written | can
+        return bank, written | can
+
+    def cond(state):
+        _, written = state
+        return jnp.any(valid & ~written)
+
+    def body(state):
+        bank, written = state
+        bank, written = write_pass(bank, written)
         leftover = jnp.any(valid & ~written)
         bank = jax.lax.cond(
             leftover,
@@ -296,9 +302,33 @@ def _add_batch_impl(bank: TDigestBank, slots, values, weights,
         )
         return bank, written
 
-    bank, _ = jax.lax.while_loop(
-        cond, body, (bank, jnp.zeros_like(valid)))
-    return bank
+    def loop_path(bank):
+        bank, _ = jax.lax.while_loop(
+            cond, body, (bank, jnp.zeros_like(valid)))
+        return bank
+
+    def fast_path(bank):
+        # the overflow predicate guarantees every valid sample fits, so
+        # positions are direct (no done/wrote segment scatters needed —
+        # the per-slot batch counts were already materialized for the
+        # predicate itself)
+        pos = bank.buf_n[jnp.where(valid, s, 0)] + rank
+        row = jnp.where(valid, s, K)
+        col = jnp.clip(pos, 0, B - 1)
+        return bank._replace(
+            buf_value=bank.buf_value.at[row, col].set(v, mode="drop"),
+            buf_weight=bank.buf_weight.at[row, col].set(w, mode="drop"),
+            buf_n=bank.buf_n + batch_per_slot)
+
+    # The common case — no slot's buffer overflows — needs exactly one
+    # write pass; the while_loop's carried-state machinery costs ~25%
+    # of the dispatch on the CPU backend even when it runs one
+    # iteration. Branch on the actual overflow condition (per-slot
+    # batch count + current fill vs capacity) and keep the loop for
+    # the hot-slot case only.
+    batch_per_slot = scatter.segment_count(s, valid, K)
+    overflows = jnp.any(bank.buf_n + batch_per_slot > B)
+    return jax.lax.cond(overflows, loop_path, fast_path, bank)
 
 
 add_batch = partial(jax.jit, static_argnames=("compression",),
