@@ -756,6 +756,12 @@ struct PbReader {
     if (!ok) return false;
     *field = static_cast<uint32_t>(t >> 3);
     *wt = static_cast<uint32_t>(t & 7);
+    // wire-format limits the decoders we must agree with enforce:
+    // field numbers are 1..2^29-1 (0 and oversized tags are illegal)
+    if (*field == 0 || (t >> 3) > 536870911ull) {
+      ok = false;
+      return false;
+    }
     return true;
   }
 
@@ -782,7 +788,7 @@ struct PbReader {
     return v;
   }
 
-  void skip(uint32_t wt) {
+  void skip(uint32_t field, uint32_t wt, int depth = 0) {
     switch (wt) {
       case 0: varint(); break;
       case 1: p = (end - p >= 8) ? p + 8 : (ok = false, end); break;
@@ -792,8 +798,30 @@ struct PbReader {
         bytes(&s, &n);
         break;
       }
+      case 3: {
+        // START_GROUP in an unknown field: the decoders we must agree
+        // with accept well-formed groups (matching END_GROUP number),
+        // reject unterminated/mismatched ones. Depth-capped.
+        if (depth >= 16) {
+          ok = false;
+          return;
+        }
+        uint32_t f2, w2;
+        while (true) {
+          if (!tag(&f2, &w2)) {
+            ok = false;  // EOF inside a group
+            return;
+          }
+          if (w2 == 4) {
+            if (f2 != field) ok = false;
+            return;
+          }
+          skip(f2, w2, depth + 1);
+          if (!ok) return;
+        }
+      }
       case 5: p = (end - p >= 4) ? p + 4 : (ok = false, end); break;
-      default: ok = false;
+      default: ok = false;  // bare END_GROUP (4) or invalid 6/7
     }
   }
 };
@@ -813,7 +841,7 @@ bool parse_tag_entry(const uint8_t* s, size_t n,
     } else if (f == 2 && wt == 2) {
       if (!r.bytes(&v, &vn)) return false;
     } else {
-      r.skip(wt);
+      r.skip(f, wt);
     }
     if (!r.ok) return false;
   }
@@ -828,43 +856,50 @@ bool parse_tag_entry(const uint8_t* s, size_t n,
 }
 
 struct SsfSample {
-  uint64_t metric = 0;
+  // proto3 enums are int32: varints truncate to the low 32 bits,
+  // signed — matching the Python decoder (a 2^32+4 wire value IS
+  // STATUS there, and must be here too)
+  int32_t metric = 0;
   std::string name, message, unit;
   float value = 0.0f;
   float rate = 0.0f;
-  uint64_t scope = 0;
+  int32_t scope = 0;
   std::vector<std::pair<std::string, std::string>> tags;  // raw k, v
 };
 
+// A known field whose wire type doesn't match its declaration is
+// treated as an unknown field and skipped — proto3 parser semantics,
+// which the Python decoder follows; diverging here would make the two
+// paths accept different byte streams.
 bool parse_ssf_sample(const uint8_t* s, size_t n, SsfSample* out) {
   PbReader r{s, s + n};
   uint32_t f, wt;
   while (r.tag(&f, &wt)) {
     const uint8_t* b;
     size_t bn;
-    switch (f) {
-      case 1: out->metric = r.varint(); break;                // Metric
-      case 2:                                                 // name
-        if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
-        out->name.assign(reinterpret_cast<const char*>(b), bn);
-        break;
-      case 3: out->value = r.f32(); break;                    // value
-      case 5:                                                 // message
-        if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
-        out->message.assign(reinterpret_cast<const char*>(b), bn);
-        break;
-      case 7: out->rate = r.f32(); break;                     // rate
-      case 8:                                                 // tags
-        if (!r.bytes(&b, &bn)) return false;
-        out->tags.emplace_back();
-        if (!parse_tag_entry(b, bn, &out->tags.back())) return false;
-        break;
-      case 9:                                                 // unit
-        if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
-        out->unit.assign(reinterpret_cast<const char*>(b), bn);
-        break;
-      case 10: out->scope = r.varint(); break;                // Scope
-      default: r.skip(wt);
+    if (f == 1 && wt == 0) {                                  // Metric
+      out->metric = static_cast<int32_t>(r.varint());
+    } else if (f == 2 && wt == 2) {                           // name
+      if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
+      out->name.assign(reinterpret_cast<const char*>(b), bn);
+    } else if (f == 3 && wt == 5) {                           // value
+      out->value = r.f32();
+    } else if (f == 5 && wt == 2) {                           // message
+      if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
+      out->message.assign(reinterpret_cast<const char*>(b), bn);
+    } else if (f == 7 && wt == 5) {                           // rate
+      out->rate = r.f32();
+    } else if (f == 8 && wt == 2) {                           // tags
+      if (!r.bytes(&b, &bn)) return false;
+      out->tags.emplace_back();
+      if (!parse_tag_entry(b, bn, &out->tags.back())) return false;
+    } else if (f == 9 && wt == 2) {                           // unit
+      if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
+      out->unit.assign(reinterpret_cast<const char*>(b), bn);
+    } else if (f == 10 && wt == 0) {                          // Scope
+      out->scope = static_cast<int32_t>(r.varint());
+    } else {
+      r.skip(f, wt);
     }
     if (!r.ok) return false;
   }
@@ -901,8 +936,9 @@ bool sample_to_parsed(const SsfSample& s, ParsedMetric* m) {
     m->value = static_cast<double>(s.value) * scale_ms;
   }
   m->rate = (s.rate != 0.0f) ? s.rate : 1.0;
-  m->scope = (s.scope <= 2) ? static_cast<uint8_t>(s.scope)
-                            : static_cast<uint8_t>(SC_MIXED);
+  m->scope = (s.scope >= 0 && s.scope <= 2)
+                 ? static_cast<uint8_t>(s.scope)
+                 : static_cast<uint8_t>(SC_MIXED);
   m->name = s.name;
   if (m->mtype == MT_SET) m->member = s.message;
   // proto3 map semantics: for duplicate keys on the wire, the LAST
@@ -951,24 +987,37 @@ int handle_ssf(Bridge* br, LocalStage* st, const uint8_t* data,
   int64_t start_ts = 0, end_ts = 0;
   std::string service;
   uint32_t f, wt;
+  std::pair<std::string, std::string> scratch_tag;
   while (r.tag(&f, &wt)) {
     const uint8_t* b;
     size_t bn;
-    switch (f) {
-      case 5: start_ts = static_cast<int64_t>(r.varint()); break;
-      case 6: end_ts = static_cast<int64_t>(r.varint()); break;
-      case 7: error = r.varint() != 0; break;
-      case 8:                                              // service
-        if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return -1;
-        service.assign(reinterpret_cast<const char*>(b), bn);
-        break;
-      case 10: indicator = r.varint() != 0; break;
-      case 12:                                             // metrics
-        if (!r.bytes(&b, &bn)) return -1;
-        samples.emplace_back();
-        if (!parse_ssf_sample(b, bn, &samples.back())) return -1;
-        break;
-      default: r.skip(wt);
+    if (f == 5 && wt == 0) {
+      start_ts = static_cast<int64_t>(r.varint());
+    } else if (f == 6 && wt == 0) {
+      end_ts = static_cast<int64_t>(r.varint());
+    } else if (f == 7 && wt == 0) {
+      error = r.varint() != 0;
+    } else if (f == 8 && wt == 2) {                        // service
+      if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return -1;
+      service.assign(reinterpret_cast<const char*>(b), bn);
+    } else if (f == 9 && wt == 2) {
+      // span-level tags: unused by the metric extraction, but KNOWN to
+      // the schema — the Python decoder parses and validates every
+      // known submessage/string field, so the native path must reject
+      // what it would reject (a skipped-but-malformed entry was a
+      // fuzz-found false accept)
+      if (!r.bytes(&b, &bn)) return -1;
+      if (!parse_tag_entry(b, bn, &scratch_tag)) return -1;
+    } else if (f == 10 && wt == 0) {
+      indicator = r.varint() != 0;
+    } else if (f == 11 && wt == 2) {                       // span name
+      if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return -1;
+    } else if (f == 12 && wt == 2) {                       // metrics
+      if (!r.bytes(&b, &bn)) return -1;
+      samples.emplace_back();
+      if (!parse_ssf_sample(b, bn, &samples.back())) return -1;
+    } else {
+      r.skip(f, wt);
     }
     if (!r.ok) return -1;
   }

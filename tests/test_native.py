@@ -754,6 +754,144 @@ class TestNativeSSF:
             srv.stop()
 
 
+class TestSSFByteFuzz:
+    """Byte-level fuzz of the C++ SSF protobuf walker: it parses
+    attacker-controlled datagram bytes, so it gets the same treatment
+    as the statsd parser — random byte soup and mutated valid spans
+    must never crash the process, the verdict must stay in {-1, 0, 1},
+    and accepted spans must agree with the Python decoder on what was
+    staged (a differential check, not just a no-crash check)."""
+
+    def _mk_valid(self, rng):
+        from veneur_tpu.ssf.protos import ssf_pb2
+        sp = ssf_pb2.SSFSpan()
+        sp.version = 1
+        if rng.random() < 0.3:
+            sp.indicator = True
+            sp.service = "svc"
+            sp.start_timestamp = 10**18
+            sp.end_timestamp = 10**18 + rng.randrange(10**9)
+        for _ in range(rng.randrange(0, 4)):
+            s = sp.metrics.add()
+            s.metric = rng.choice([0, 1, 2, 3, 4])
+            s.name = rng.choice(["m.a", "m.b", "", "x" * 60])
+            s.value = rng.uniform(-1e6, 1e6)
+            if s.metric == 3:
+                s.message = rng.choice(["u1", "ü", ""])
+            if rng.random() < 0.4:
+                s.unit = rng.choice(["ms", "s", "ns", "µs", "things"])
+            if rng.random() < 0.5:
+                s.sample_rate = rng.choice([0.0, 0.25, 1.0])
+            for _ in range(rng.randrange(0, 3)):
+                s.tags[rng.choice("abcd")] = rng.choice(["", "v", "ß"])
+            s.scope = rng.randrange(0, 4)
+        return sp.SerializeToString()
+
+    def test_ssf_fuzz_differential(self):
+        from veneur_tpu.sinks.ssfmetrics import sample_to_metric
+        from veneur_tpu.ssf.protos import ssf_pb2
+
+        rng = random.Random(23)
+        br = native.NativeBridge(histo_slots=512, counter_slots=512,
+                                 gauge_slots=256, set_slots=128,
+                                 hll_precision=14, idle_ttl=4,
+                                 ring_capacity=1 << 18, max_packet=8192)
+        try:
+            staged_expect = 0
+            for i in range(2500):
+                data = self._mk_valid(rng)
+                if i % 2:
+                    # mutate: flip/truncate/duplicate bytes
+                    buf = bytearray(data)
+                    for _ in range(rng.randrange(1, 4)):
+                        op = rng.randrange(3)
+                        if op == 0 and buf:
+                            buf[rng.randrange(len(buf))] = \
+                                rng.randrange(256)
+                        elif op == 1 and buf:
+                            del buf[rng.randrange(len(buf)):]
+                        else:
+                            j = rng.randrange(len(buf) + 1)
+                            buf[j:j] = buf[:rng.randrange(6)]
+                    data = bytes(buf)
+                rc = br.handle_ssf(data)
+                assert rc in (-1, 0, 1), rc
+                if rc == 1:
+                    # differential: the Python decoder must also accept
+                    # it, agree there are no STATUS samples, and agree
+                    # on how many samples extract
+                    sp = ssf_pb2.SSFSpan.FromString(data)
+                    assert not any(
+                        s.metric == ssf_pb2.SSFSample.STATUS
+                        and s.name for s in sp.metrics)
+                    # (indicator spans stage no extra timer here — the
+                    # timer name is unset on this bridge)
+                    staged_expect += sum(
+                        1 for s in sp.metrics
+                        if sample_to_metric(s) is not None)
+            st = br.stats()
+            landed = int(st["samples"]) + int(st["drops_no_slot"])
+            assert landed == staged_expect, (landed, staged_expect)
+        finally:
+            br.close()
+
+    def test_ssf_wire_format_parity_cases(self):
+        """Targeted wire-format corners where the native walker must
+        agree with the Python decoder byte-for-byte: unknown groups
+        (accepted when well-formed, rejected when broken), illegal
+        field numbers, and enum varints truncating to int32."""
+        from veneur_tpu.ssf.protos import ssf_pb2
+        base = ssf_pb2.SSFSpan(version=1).SerializeToString()
+        br = native.NativeBridge(histo_slots=64, counter_slots=64,
+                                 gauge_slots=64, set_slots=64,
+                                 hll_precision=14, idle_ttl=4,
+                                 ring_capacity=4096, max_packet=8192)
+        try:
+            cases = [
+                (bytes([0x7b, 0x7c]), True),          # empty group
+                (bytes([0x7b, 0x08, 0x05, 0x7c]), True),  # inner varint
+                (bytes([0x7b, 0x63, 0x64, 0x7c]), True),  # nested
+                (bytes([0x7b, 0x6c]), False),         # mismatched end
+                (bytes([0x7b]), False),               # unterminated
+                (bytes([0x7c]), False),               # bare end group
+                (bytes([0x00, 0x00]), False),         # field number 0
+            ]
+            for extra, py_accepts in cases:
+                data = base + extra
+                rc = br.handle_ssf(data)
+                try:
+                    ssf_pb2.SSFSpan.FromString(data)
+                    assert py_accepts
+                except Exception:
+                    assert not py_accepts
+                assert (rc >= 0) == py_accepts, (extra.hex(), rc)
+            # enum varint truncation: metric = 2^32 + 4 decodes as
+            # STATUS in python -> native must fall back, not stage
+            sample = (bytes([1 << 3])                 # field 1 varint
+                      + bytes([0x84, 0x80, 0x80, 0x80, 0x10])  # 2^32+4
+                      + bytes([(2 << 3) | 2, 3]) + b"chk")
+            span = bytes([(12 << 3) | 2, len(sample)]) + sample
+            py = ssf_pb2.SSFSpan.FromString(span)
+            assert py.metrics[0].metric == ssf_pb2.SSFSample.STATUS
+            assert br.handle_ssf(span) == 0   # whole-datagram fallback
+        finally:
+            br.close()
+
+    def test_ssf_random_byte_soup(self):
+        rng = random.Random(29)
+        br = native.NativeBridge(histo_slots=64, counter_slots=64,
+                                 gauge_slots=64, set_slots=64,
+                                 hll_precision=14, idle_ttl=4,
+                                 ring_capacity=4096, max_packet=8192)
+        try:
+            for _ in range(3000):
+                n = rng.randrange(0, 80)
+                data = bytes(rng.randrange(256) for _ in range(n))
+                assert br.handle_ssf(data) in (-1, 0, 1)
+        finally:
+            br.close()
+
+
 class TestByteFuzz:
     """Raw byte-level fuzz: arbitrary byte soup and mutated valid lines.
     Neither parser may crash, and verdicts/values must stay conformant
