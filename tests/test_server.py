@@ -196,6 +196,102 @@ def test_config_validation_rejects_nonsense():
 
 
 @pytest.mark.slow
+def test_live_flush_loop_exact_accounting_soak():
+    """Full-server soak: the REAL flush loop ticks while native UDP
+    statsd and SSF span traffic flows concurrently — the flush-swap vs
+    pump vs listener interleaving where the r5 zero-copy aliasing
+    corruption lived. At the end, the SUM of flushed counter values
+    across every interval must equal exactly what landed (counters are
+    exact by contract), and histogram counts must account likewise.
+    Accounting is by VALUE, not by landed-counter — landed counts
+    stayed perfect while the banks rotted under the aliasing bug."""
+    from veneur_tpu.config import Config
+    from veneur_tpu.ssf.protos import ssf_pb2
+
+    cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                 ssf_listen_addresses=["udp://127.0.0.1:0"],
+                 interval="1s", hostname="soak", native_ingest=True,
+                 num_readers=1, aggregates=["count"],
+                 percentiles=[0.5],
+                 tpu_histogram_slots=1024, tpu_counter_slots=1024,
+                 tpu_gauge_slots=64, tpu_set_slots=64)
+    sink = CaptureMetricSink()
+    srv = Server(cfg, sinks=[sink], plugins=[])
+    srv.start()
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        port = srv.bound_port()
+        ssf_port = srv.ssf_native_port
+        sent_c = sent_t = sent_spans = 0
+        # ~6 flush intervals of steady mixed traffic, throttled well
+        # below the 1-core drop threshold
+        deadline = time.monotonic() + 6.0
+        sp = ssf_pb2.SSFSpan()
+        m1 = sp.metrics.add()
+        m1.metric = ssf_pb2.SSFSample.COUNTER
+        m1.name = "soak.span.c"
+        m1.value = 1.0
+        span_bytes = sp.SerializeToString()
+        while time.monotonic() < deadline:
+            for j in range(20):
+                s.sendto(f"soak.c{j % 7}:1|c\nsoak.t{j % 5}:3.5|ms"
+                         .encode(), ("127.0.0.1", port))
+                sent_c += 1
+                sent_t += 1
+            s.sendto(span_bytes, ("127.0.0.1", ssf_port))
+            sent_spans += 1
+            time.sleep(0.01)
+        # settle: everything parsed, pumped, landed, flushed once more
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            st = srv.native_bridge.stats()
+            if (int(st["lines"]) >= sent_c + sent_t
+                    and int(st["ssf_spans"]) >= sent_spans):
+                break
+            time.sleep(0.05)
+        st = srv.native_bridge.stats()
+        assert int(st["lines"]) == sent_c + sent_t, (st, sent_c + sent_t)
+        # >= : the server self-traces its own flushes through the same
+        # native SSF port (veneur.* spans on top of ours)
+        assert int(st["ssf_spans"]) >= sent_spans
+        assert int(st["ring_drops"]) == 0, st
+        assert srv.drain(30)   # rings, worker queues, AND slow paths
+        srv.flush_once()
+
+        # exact value accounting across ALL intervals. The fan-out
+        # hands frames to the sink on unjoined threads, so poll until
+        # the sums CONVERGE to the exact totals (flushing once more if
+        # a residual remains) instead of reading sink.flushes
+        # immediately.
+        def sums():
+            got = [0.0, 0.0, 0.0]
+            with sink._cv:
+                flushes = [list(f) for f in sink.flushes]
+            for flush in flushes:
+                for m in flush:
+                    if m.name.startswith("soak.c"):
+                        got[0] += m.value
+                    elif m.name == "soak.span.c":
+                        got[1] += m.value
+                    elif m.name.startswith("soak.t") and \
+                            m.name.endswith(".count"):
+                        got[2] += m.value
+            return got
+        want = [float(sent_c), float(sent_spans), float(sent_t)]
+        deadline = time.monotonic() + 20
+        got = sums()
+        while got != want and time.monotonic() < deadline:
+            time.sleep(0.25)
+            srv.flush_once()
+            got = sums()
+        assert got == want, (got, want)
+        assert len(sink.flushes) >= 4  # the loop really ticked
+    finally:
+        srv.stop()
+        s.close()
+
+
+@pytest.mark.slow
 def test_key_churn_soak_bounded_state():
     """Long-running-server soak: 40 flush intervals of fully-churning
     key sets must leave every unbounded-looking cache bounded — the
