@@ -732,10 +732,18 @@ void handle_buffer(Bridge* br, LocalStage* st, const uint8_t* data,
 // service checks in Python) make the WHOLE datagram fall back to the
 // Python path — never a partial native landing.
 
+// Unknown-field group nesting deeper than this makes the native parser
+// hand the datagram to the Python fallback decoder instead of erroring:
+// the Python protobuf runtime accepts deeper well-formed nesting, so
+// rejecting here would be a parity divergence (round-5 advisory).
+// MUST stay equal to ssf/framing.py PB_SKIP_MAX_DEPTH (vlint NA02).
+constexpr int kPbSkipMaxDepth = 16;
+
 struct PbReader {
   const uint8_t* p;
   const uint8_t* end;
   bool ok = true;
+  bool deep = false;  // failed ONLY by exceeding kPbSkipMaxDepth
 
   uint64_t varint() {
     uint64_t v = 0;
@@ -801,9 +809,12 @@ struct PbReader {
       case 3: {
         // START_GROUP in an unknown field: the decoders we must agree
         // with accept well-formed groups (matching END_GROUP number),
-        // reject unterminated/mismatched ones. Depth-capped.
-        if (depth >= 16) {
+        // reject unterminated/mismatched ones. Past the depth cap the
+        // datagram falls back to Python (deep flag) rather than being
+        // rejected — the fallback decoder accepts deeper nesting.
+        if (depth >= kPbSkipMaxDepth) {
           ok = false;
+          deep = true;
           return;
         }
         uint32_t f2, w2;
@@ -830,28 +841,35 @@ struct PbReader {
 // (key, value) — kept raw so map semantics (last entry wins per key)
 // can be applied before formatting
 bool parse_tag_entry(const uint8_t* s, size_t n,
-                     std::pair<std::string, std::string>* out) {
+                     std::pair<std::string, std::string>* out,
+                     bool* deep = nullptr) {
   PbReader r{s, s + n};
   const uint8_t *k = nullptr, *v = nullptr;
   size_t kn = 0, vn = 0;
   uint32_t f, wt;
   while (r.tag(&f, &wt)) {
     if (f == 1 && wt == 2) {
-      if (!r.bytes(&k, &kn)) return false;
+      r.bytes(&k, &kn);
     } else if (f == 2 && wt == 2) {
-      if (!r.bytes(&v, &vn)) return false;
+      r.bytes(&v, &vn);
     } else {
       r.skip(f, wt);
     }
-    if (!r.ok) return false;
+    if (!r.ok) break;
   }
+  if (deep != nullptr) *deep = *deep || r.deep;
   if (!r.ok) return false;
   // proto3 `string` fields must be valid UTF-8 — the Python decoder
   // rejects the whole message otherwise, and the key records these
   // bytes land in are strict-decoded downstream
   if (!utf8_valid(k, kn) || !utf8_valid(v, vn)) return false;
-  out->first.assign(reinterpret_cast<const char*>(k), kn);
-  out->second.assign(reinterpret_cast<const char*>(v), vn);
+  // a map entry may omit field 1 or 2 entirely, leaving k/v nullptr:
+  // clear() the target instead of assign(nullptr, 0), which is UB
+  // (round-5 advisory NA01)
+  if (k) out->first.assign(reinterpret_cast<const char*>(k), kn);
+  else out->first.clear();
+  if (v) out->second.assign(reinterpret_cast<const char*>(v), vn);
+  else out->second.clear();
   return true;
 }
 
@@ -871,7 +889,8 @@ struct SsfSample {
 // treated as an unknown field and skipped — proto3 parser semantics,
 // which the Python decoder follows; diverging here would make the two
 // paths accept different byte streams.
-bool parse_ssf_sample(const uint8_t* s, size_t n, SsfSample* out) {
+bool parse_ssf_sample(const uint8_t* s, size_t n, SsfSample* out,
+                      bool* deep = nullptr) {
   PbReader r{s, s + n};
   uint32_t f, wt;
   while (r.tag(&f, &wt)) {
@@ -892,7 +911,7 @@ bool parse_ssf_sample(const uint8_t* s, size_t n, SsfSample* out) {
     } else if (f == 8 && wt == 2) {                           // tags
       if (!r.bytes(&b, &bn)) return false;
       out->tags.emplace_back();
-      if (!parse_tag_entry(b, bn, &out->tags.back())) return false;
+      if (!parse_tag_entry(b, bn, &out->tags.back(), deep)) return false;
     } else if (f == 9 && wt == 2) {                           // unit
       if (!r.bytes(&b, &bn) || !utf8_valid(b, bn)) return false;
       out->unit.assign(reinterpret_cast<const char*>(b), bn);
@@ -901,8 +920,12 @@ bool parse_ssf_sample(const uint8_t* s, size_t n, SsfSample* out) {
     } else {
       r.skip(f, wt);
     }
-    if (!r.ok) return false;
+    if (!r.ok) {
+      if (deep != nullptr) *deep = *deep || r.deep;
+      return false;
+    }
   }
+  if (deep != nullptr) *deep = *deep || r.deep;
   return r.ok;
 }
 
@@ -977,17 +1000,26 @@ bool sample_to_parsed(const SsfSample& s, ParsedMetric* m) {
 }
 
 // Decode + stage one SSF datagram. Returns 1 when handled natively,
-// 0 when the caller must use the Python path (STATUS samples present),
+// 0 when the caller must use the Python path (STATUS samples present,
+// or unknown-field nesting past kPbSkipMaxDepth — the Python decoder
+// accepts deeper well-formed groups, so erroring would diverge),
 // -1 on malformed protobuf (counted; caller should count an ssf error).
 int handle_ssf(Bridge* br, LocalStage* st, const uint8_t* data,
                size_t len) {
   PbReader r{data, data + len};
   std::vector<SsfSample> samples;
-  bool indicator = false, error = false;
+  bool indicator = false, error = false, deep = false;
   int64_t start_ts = 0, end_ts = 0;
   std::string service;
   uint32_t f, wt;
   std::pair<std::string, std::string> scratch_tag;
+  auto fail = [&]() -> int {
+    if (deep || r.deep) {
+      br->ssf_fallbacks.fetch_add(1, std::memory_order_relaxed);
+      return 0;
+    }
+    return -1;
+  };
   while (r.tag(&f, &wt)) {
     const uint8_t* b;
     size_t bn;
@@ -1007,7 +1039,7 @@ int handle_ssf(Bridge* br, LocalStage* st, const uint8_t* data,
       // what it would reject (a skipped-but-malformed entry was a
       // fuzz-found false accept)
       if (!r.bytes(&b, &bn)) return -1;
-      if (!parse_tag_entry(b, bn, &scratch_tag)) return -1;
+      if (!parse_tag_entry(b, bn, &scratch_tag, &deep)) return fail();
     } else if (f == 10 && wt == 0) {
       indicator = r.varint() != 0;
     } else if (f == 11 && wt == 2) {                       // span name
@@ -1015,13 +1047,14 @@ int handle_ssf(Bridge* br, LocalStage* st, const uint8_t* data,
     } else if (f == 12 && wt == 2) {                       // metrics
       if (!r.bytes(&b, &bn)) return -1;
       samples.emplace_back();
-      if (!parse_ssf_sample(b, bn, &samples.back())) return -1;
+      if (!parse_ssf_sample(b, bn, &samples.back(), &deep))
+        return fail();
     } else {
       r.skip(f, wt);
     }
-    if (!r.ok) return -1;
+    if (!r.ok) return fail();
   }
-  if (!r.ok) return -1;
+  if (!r.ok) return fail();
   // STATUS samples become service checks in Python — whole-datagram
   // fallback so one span never lands half-natively
   for (const SsfSample& s : samples)

@@ -363,3 +363,94 @@ def test_flush_fetch_f16_out_of_range_falls_back_exact():
     for k in ref:
         assert np.isfinite(got[k]), k
         np.testing.assert_allclose(got[k], ref[k], rtol=1e-6, err_msg=k)
+
+
+def test_f16_tiny_sentinel_sits_at_min_normal():
+    """_F16_TINY must equal f16's min normal (2^-14): a nonzero
+    magnitude below it encodes as an f16 SUBNORMAL on the compact wire
+    and must trigger the full-precision refetch. The old 6.1e-5
+    sentinel left a [6.1e-5, 2^-14) band that skipped the refetch yet
+    lost precision (ADVICE r5)."""
+    import jax.numpy as jnp
+
+    from veneur_tpu.models import pipeline
+
+    assert pipeline._F16_TINY == 2.0 ** -14
+
+    def fetched_keys(tiny_mag):
+        out = {
+            "lo_mag": jnp.float32(0.0),
+            "overflow_mag": jnp.float32(1.0),
+            "tiny_mag": jnp.float32(tiny_mag),
+            "q16": jnp.zeros((2, 2), jnp.float16),
+            "q32": jnp.zeros((2, 2), jnp.float32),
+        }
+        return pipeline.fetch_flush_outputs(out, "sync")
+
+    # below min normal -> subnormal on the wire -> must refetch q32
+    assert "q32" in fetched_keys(6.1e-5)
+    # inside the OLD sentinel's blind band -> must refetch now
+    assert "q32" in fetched_keys(6.103e-5)
+    # at/above min normal (6.10352e-5 > 2^-14) -> no refetch needed
+    assert "q32" not in fetched_keys(6.10352e-5)
+
+
+def test_sparse_high_slot_batch_skips_bincount():
+    """Hot-slot detection must not allocate a max(slot)+1-sized
+    bincount for sparse high-slot-id batches (ADVICE r5): batches with
+    <= buffer_depth valid rows skip counting entirely, and larger
+    batches whose max slot id dwarfs the batch count via np.unique.
+    The np.unique arm must still find the hot slot and stay exact."""
+    from veneur_tpu.ingest.parser import MetricKey
+    from veneur_tpu.models import pipeline as pipeline_mod
+
+    K = 1 << 15
+    eng = AggregationEngine(EngineConfig(
+        histogram_slots=K, counter_slots=8, gauge_slots=8, set_slots=8,
+        buffer_depth=32, batch_size=1024, percentiles=(0.5,),
+        aggregates=("count", "sum")))
+    # intern one key onto the HIGHEST slot id (the free list pops from
+    # the back; reversing it hands out slot K-1 first) — the shape a
+    # native-bridge interner produces after long churn
+    eng.histo_keys._free.reverse()
+    hi = eng.histo_keys.lookup(MetricKey("hi.t", "timer", ""), 0)
+    assert hi == K - 1
+
+    real_bincount = np.bincount
+
+    def forbidden_bincount(*a, **kw):
+        raise AssertionError("np.bincount called for a sparse "
+                             "high-slot batch")
+
+    # (a) tiny batch (<= buffer_depth valid rows): no counting at all
+    pipeline_mod.np.bincount = forbidden_bincount
+    try:
+        n = 16
+        eng.ingest_histo_batch(np.full(n, hi, np.int32),
+                               np.arange(1, n + 1, dtype=np.float32),
+                               np.ones(n, np.float32))
+        # (b) big sparse batch with a genuinely hot slot: unique arm
+        n = 640  # > buffer_depth; hi = 32767 > 16 * 640
+        eng.ingest_histo_batch(np.full(n, hi, np.int32),
+                               np.arange(1, n + 1, dtype=np.float32),
+                               np.ones(n, np.float32))
+    finally:
+        pipeline_mod.np.bincount = real_bincount
+
+    by = {m.name: m.value for m in eng.flush(timestamp=1).metrics}
+    assert by["hi.t.count"] == 16.0 + 640.0
+    exp = np.arange(1, 17).sum() + np.arange(1, 641).sum()
+    assert by["hi.t.sum"] == pytest.approx(float(exp), rel=1e-6)
+
+
+def test_dense_batch_still_uses_bincount_and_matches():
+    """The dense arm (bincount) must be unchanged: same flush output
+    for the same data fed through small interleaved batches."""
+    eng = AggregationEngine(small_config(buffer_depth=32,
+                                         batch_size=512,
+                                         percentiles=(0.5,),
+                                         aggregates=("count", "sum")))
+    feed(eng, [f"d.t:{v}|ms".encode() for v in range(1, 257)])
+    by = {m.name: m.value for m in eng.flush(timestamp=1).metrics}
+    assert by["d.t.count"] == 256.0
+    assert by["d.t.sum"] == pytest.approx(256 * 257 / 2, rel=1e-6)

@@ -922,3 +922,95 @@ class TestByteFuzz:
                     i = rng.randrange(len(line) + 1)
                     line[i:i] = line[:rng.randrange(8)]
             assert_conformant(bytes(line))
+
+
+class TestDeepGroupNestingParity:
+    """Unknown-field group nesting past the native depth cap must FALL
+    BACK to the Python decoder (rc 0), not error (rc -1): the
+    google.protobuf runtime accepts deeper well-formed groups, so a
+    native reject would be a parity divergence (ADVICE r5 / vlint NA02).
+    The cap itself has one definition on each side, asserted equal."""
+
+    def _bridge(self):
+        return native.NativeBridge(histo_slots=64, counter_slots=64,
+                                   gauge_slots=64, set_slots=64,
+                                   hll_precision=14, idle_ttl=4,
+                                   ring_capacity=4096, max_packet=8192)
+
+    @staticmethod
+    def _nested_group(depth):
+        """An unknown SSFSpan field (15) holding `depth` nested groups:
+        START_GROUP tag (15<<3)|3 = 123, END_GROUP (15<<3)|4 = 124."""
+        body = b""
+        for _ in range(depth):
+            body = bytes([123]) + body + bytes([124])
+        return body
+
+    def test_cap_constant_parity(self):
+        import os
+        import re
+
+        from veneur_tpu.ssf import framing
+        cpp = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "native", "vtpu_ingest.cpp")
+        with open(cpp) as fh:
+            m = re.search(r"constexpr int kPbSkipMaxDepth = (\d+);",
+                          fh.read())
+        assert m, "kPbSkipMaxDepth missing from vtpu_ingest.cpp"
+        assert int(m.group(1)) == framing.PB_SKIP_MAX_DEPTH
+
+    def test_deep_nesting_falls_back_not_error(self):
+        from veneur_tpu.ssf import framing
+
+        br = self._bridge()
+        try:
+            deep = self._nested_group(framing.PB_SKIP_MAX_DEPTH + 4)
+            assert br.handle_ssf(deep) == 0   # Python path, not -1
+            # ...and the Python decoder really does accept it
+            framing.parse_ssf_datagram(deep)
+            # shallow nesting stays on the native fast path
+            shallow = self._nested_group(framing.PB_SKIP_MAX_DEPTH - 4)
+            assert br.handle_ssf(shallow) == 1
+            # a malformed (unterminated) group is still an error on
+            # both paths, at any depth
+            unterminated = bytes([123]) * 4
+            assert br.handle_ssf(unterminated) == -1
+        finally:
+            br.close()
+
+
+class TestTagEntryFieldOmission:
+    """A map<string,string> entry may omit field 1 (key) or 2 (value)
+    entirely — the raw pointers stay null in the native parser. The
+    fixed path clear()s instead of assign(nullptr, 0) (UB; ADVICE r5 /
+    vlint NA01) and must agree with the Python decoder, which yields ""
+    for the omitted half."""
+
+    def test_omitted_key_and_value_parse_like_python(self):
+        from veneur_tpu.sinks.ssfmetrics import sample_to_metric
+        from veneur_tpu.ssf import framing
+
+        def pb_len(field, payload: bytes) -> bytes:
+            return bytes([(field << 3) | 2, len(payload)]) + payload
+
+        br = native.NativeBridge(histo_slots=64, counter_slots=64,
+                                 gauge_slots=64, set_slots=64,
+                                 hll_precision=14, idle_ttl=4,
+                                 ring_capacity=4096, max_packet=8192)
+        try:
+            # counter sample "c.x" with one tag entry carrying ONLY a
+            # value (no key) and one carrying ONLY a key (no value)
+            sample = (bytes([1 << 3, 0]) + pb_len(2, b"c.x")
+                      + bytes([(3 << 3) | 5]) + b"\x00\x00\x80\x3f"
+                      + pb_len(8, pb_len(2, b"justval"))
+                      + pb_len(8, pb_len(1, b"justkey")))
+            dgram = pb_len(12, sample)
+            assert br.handle_ssf(dgram) == 1
+            (rec,) = br.drain_new_keys()
+            _bank, _mt, _scope, _slot, name, joined = rec
+            span = framing.parse_ssf_datagram(dgram)
+            m = sample_to_metric(span.metrics[0])
+            assert name == m.key.name
+            assert joined == m.key.joined_tags
+        finally:
+            br.close()

@@ -334,3 +334,48 @@ def test_key_churn_soak_bounded_state():
     # presentation caches bounded by their documented caps
     assert len(eng._tags_cache) <= eng._pres_bound
     assert len(sink._tag_memo) < 65536
+
+
+def test_native_listeners_receive_configured_rcvbuf(monkeypatch):
+    """Both native UDP listeners — statsd AND SSF — must be started
+    with the configured read buffer size (ADVICE r5 / vlint CF01
+    exemplar: start_ssf_udp used to be started on the ~208KB kernel
+    default while start_udp got the configured 2MB)."""
+    import pytest as _pytest
+
+    from veneur_tpu.config import Config
+    native = _pytest.importorskip("veneur_tpu.ingest.native")
+    try:
+        native.load()
+    except native.NativeUnavailable as e:  # pragma: no cover
+        _pytest.skip(f"native build unavailable: {e}")
+
+    calls = {}
+
+    def fake_start_udp(self, host, port, n_readers, rcvbuf=0):
+        calls["statsd"] = rcvbuf
+        return port or 1
+
+    def fake_start_ssf_udp(self, host, port, n_readers, rcvbuf=0,
+                           max_dgram=16384):
+        calls["ssf"] = rcvbuf
+        return port or 2
+
+    monkeypatch.setattr(native.NativeBridge, "start_udp",
+                        fake_start_udp)
+    monkeypatch.setattr(native.NativeBridge, "start_ssf_udp",
+                        fake_start_ssf_udp)
+    cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                 ssf_listen_addresses=["udp://127.0.0.1:0"],
+                 interval="1s", native_ingest=True, num_readers=1,
+                 read_buffer_size_bytes=5 << 20,
+                 tpu_histogram_slots=512, tpu_counter_slots=256,
+                 tpu_gauge_slots=256, tpu_set_slots=128)
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[])
+    try:
+        srv._start_statsd_listener(cfg.statsd_listen_addresses[0])
+        srv._start_ssf_listener(cfg.ssf_listen_addresses[0])
+    finally:
+        srv.stop()
+    assert calls["statsd"] == 5 << 20
+    assert calls["ssf"] == 5 << 20

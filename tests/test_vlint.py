@@ -1,0 +1,71 @@
+"""vlint unit tests: every check ID must catch its seeded fixture
+violation (exact rule AND line), the clean fixture must stay silent,
+and the suppression contract must hold (reason suppresses, no reason
+reports VL00 and keeps the finding)."""
+
+import os
+
+from tools.vlint import run_paths
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "vlint_fixtures")
+
+
+def lint(*names):
+    vs = run_paths([os.path.join(FIX, n) for n in names])
+    return [(v.rule, v.line) for v in vs]
+
+
+def test_jx01_tracer_leak_item():
+    assert lint("jx01_bad.py") == [("JX01", 7)]
+
+
+def test_jx02_donation_use_after_dispatch():
+    assert lint("jx02_bad.py") == [("JX02", 9)]
+
+
+def test_jx03_host_sync_outside_flush_modules():
+    assert lint("jx03_bad.py") == [("JX03", 6)]
+
+
+def test_th01_unguarded_write_multi_thread_method():
+    # exactly the unguarded write — the lock-guarded one on line 21
+    # must NOT be reported
+    assert lint("server.py") == [("TH01", 19)]
+
+
+def test_cf01_cfg_plumbing_missing_at_sibling():
+    assert lint("cf01_bad.py") == [("CF01", 21)]
+
+
+def test_na01_nullptr_assign():
+    # the guarded twin function in the same file must stay silent
+    assert lint("na01_bad.cpp") == [("NA01", 12)]
+
+
+def test_na02_magic_recursion_cap():
+    assert lint("na02_bad.cpp") == [("NA02", 5)]
+
+
+def test_na02_cap_diverges_from_python_constant():
+    assert lint("na02_diverge.cpp", "na02_parity.py") == [("NA02", 7)]
+
+
+def test_clean_fixture_is_clean():
+    assert lint("clean.py") == []
+
+
+def test_suppression_with_reason_suppresses():
+    got = lint("suppressed.py")
+    # documented sync on line 8 is suppressed; the reasonless disable
+    # on line 12 suppresses nothing and is itself reported as VL00
+    assert ("JX03", 8) not in got
+    assert ("JX03", 12) in got
+    assert ("VL00", 12) in got
+    assert len(got) == 2
+
+
+def test_violation_str_is_clickable():
+    vs = run_paths([os.path.join(FIX, "jx01_bad.py")])
+    assert str(vs[0]).startswith(
+        os.path.join(FIX, "jx01_bad.py").replace(os.sep, "/") + ":7: ")

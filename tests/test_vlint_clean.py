@@ -1,0 +1,29 @@
+"""Tier-1 gate: the tree must be vlint-clean.
+
+Runs the analyzer exactly as documented — `python -m tools.vlint
+veneur_tpu/ native/` — and requires exit 0. Any new violation either
+gets fixed or carries an inline `# vlint: disable=XXnn reason=...`
+explaining why it is intentional; see tools/vlint/README.md.
+"""
+
+import os
+import subprocess
+import sys
+
+from tools.vlint import run_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tree_is_vlint_clean_api():
+    vs = run_paths([os.path.join(REPO, "veneur_tpu"),
+                    os.path.join(REPO, "native")])
+    assert vs == [], "\n" + "\n".join(str(v) for v in vs)
+
+
+def test_cli_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.vlint", "veneur_tpu", "native"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "vlint: clean" in proc.stdout

@@ -1,0 +1,33 @@
+"""Clean fixture: jit, donation, and threading used correctly — the
+negative case for every Python check."""
+import threading
+
+import jax
+
+step = jax.jit(lambda bank, xs: bank + xs, donate_argnums=(0,))
+
+
+@jax.jit
+def scale(x):
+    return x * 2.0
+
+
+def run(bank, xs):
+    bank = step(bank, xs)
+    return bank
+
+
+class Worker:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+
+    def _loop(self):
+        self.bump()
+
+    def bump(self):
+        with self.lock:
+            self.n += 1

@@ -1,0 +1,7 @@
+"""JX01 fixture: tracer leak inside a jitted function."""
+import jax
+
+
+@jax.jit
+def bad(x):
+    return x.item()

@@ -1,0 +1,9 @@
+"""JX02 fixture: donated buffer read again after dispatch."""
+import jax
+
+step = jax.jit(lambda bank, xs: bank + xs, donate_argnums=(0,))
+
+
+def run(bank, xs):
+    out = step(bank, xs)
+    return out + bank.sum()

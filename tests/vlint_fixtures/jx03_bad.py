@@ -1,0 +1,6 @@
+"""JX03 fixture: host sync outside the flush/fetch modules."""
+import jax
+
+
+def poll_counters(bank):
+    return jax.device_get(bank)

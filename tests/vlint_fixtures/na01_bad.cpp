@@ -1,0 +1,22 @@
+// NA01 fixture: nullptr-reachable string::assign.
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+void read_field(const uint8_t** k, size_t* kn);
+
+bool parse_entry(std::string* out) {
+  const uint8_t* k = nullptr;
+  size_t kn = 0;
+  read_field(&k, &kn);
+  out->assign(reinterpret_cast<const char*>(k), kn);
+  return true;
+}
+
+bool parse_entry_guarded(std::string* out) {
+  const uint8_t* k = nullptr;
+  size_t kn = 0;
+  read_field(&k, &kn);
+  if (k) out->assign(reinterpret_cast<const char*>(k), kn);
+  return true;
+}

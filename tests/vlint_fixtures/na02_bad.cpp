@@ -1,0 +1,11 @@
+// NA02 fixture: magic recursion cap (unnamed literal).
+struct Reader {
+  bool ok = true;
+  void skip(int wt, int depth = 0) {
+    if (depth >= 12) {
+      ok = false;
+      return;
+    }
+    skip(wt, depth + 1);
+  }
+};

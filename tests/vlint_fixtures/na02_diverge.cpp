@@ -1,0 +1,13 @@
+// NA02 fixture: named cap that diverges from the Python constant.
+constexpr int kCap = 8;
+
+struct Reader {
+  bool ok = true;
+  void skip(int wt, int depth = 0) {
+    if (depth >= kCap) {
+      ok = false;
+      return;
+    }
+    skip(wt, depth + 1);
+  }
+};

@@ -1,0 +1,3 @@
+"""NA02 fixture companion: the Python-side parity constant."""
+
+PB_SKIP_MAX_DEPTH = 16

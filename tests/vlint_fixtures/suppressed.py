@@ -1,0 +1,12 @@
+"""Suppression fixture: a documented disable suppresses; a reasonless
+one does not (and is itself reported as VL00)."""
+import jax
+
+
+def sync_documented(bank):
+    # vlint: disable=JX03 reason=fixture documents this sync point
+    return jax.device_get(bank)
+
+
+def sync_reasonless(bank):
+    return jax.device_get(bank)  # vlint: disable=JX03

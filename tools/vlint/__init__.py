@@ -1,0 +1,19 @@
+"""vlint — project-native static analysis for veneur-tpu.
+
+Checks (see tools/vlint/README.md for the full contract):
+  JX01  tracer leak inside a jitted function
+  JX02  donated buffer read after dispatch
+  JX03  host sync outside the flush/fetch modules
+  TH01  unguarded shared-state write in the threaded server files
+  CF01  config-plumbing parity across sibling listener-start calls
+  NA01  nullptr-reachable string::assign in the native bridge
+  NA02  native/Python decoder recursion-cap divergence
+  VL00  suppression without a reason
+  VL01  file failed to parse
+
+Run: `python -m tools.vlint veneur_tpu/ native/`
+"""
+
+from .core import Violation, run_paths  # noqa: F401
+
+__all__ = ["Violation", "run_paths"]

@@ -1,0 +1,32 @@
+"""Default check configuration.
+
+Paths are suffix-matched against posix-normalised file paths, so the
+tool behaves the same whether invoked from the repo root or with
+absolute paths.
+"""
+
+DEFAULT_CONFIG = {
+    # JX03: modules allowed to synchronise with the device. The flush /
+    # fetch layer owns every legitimate device_get/block_until_ready in
+    # the serving path; the native/*.py entries are offline validation
+    # harnesses, not servers.
+    "jx03_allow": (
+        "veneur_tpu/models/pipeline.py",
+        "veneur_tpu/parallel/mesh.py",
+        "veneur_tpu/parallel/engine.py",
+        "native/pallas_validate.py",
+        "native/tsan_stress.py",
+    ),
+    # TH01: files whose classes run methods from multiple threads
+    # (listener/worker/flush topology lives here).
+    "th01_files": ("server.py", "engine.py"),
+    # TH01: methods whose name ends with one of these run entirely under
+    # a lock the CALLER holds (project convention).
+    "th01_locked_suffixes": ("_locked",),
+    # CF01: attribute-call families checked for config-plumbing parity —
+    # sibling calls share a receiver and a method-name prefix token.
+    "cf01_prefixes": ("start",),
+    # NA02: the Python-side parity constant for the native decoder's
+    # recursion cap.
+    "na02_py_constant": "PB_SKIP_MAX_DEPTH",
+}

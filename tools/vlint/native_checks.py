@@ -1,0 +1,139 @@
+"""Line-based C++ passes for native/vtpu_ingest.cpp: NA01, NA02.
+
+These are deliberately regex-level — the native bridge is one file of
+C-with-classes and the two defect classes it has actually shipped
+(nullptr .assign(), parity-diverging recursion caps) are recognisable
+from surface syntax. A real C++ frontend would be overkill for a
+tier-1 gate that must run in milliseconds with no extra deps.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .core import NativeFile, Violation
+
+# const uint8_t *k = nullptr, *v = nullptr;   (captures each name)
+_NULLPTR_DECL_RE = re.compile(r"\*\s*(\w+)\s*=\s*nullptr\b")
+# later rebinding that clears the nullptr taint: k = <something>;
+_REBIND_RE = re.compile(r"(?:^|[^\w.>])%s\s*=\s*(?!nullptr)[^=]")
+# .assign(reinterpret_cast<const char*>(k), kn)  /  ->assign(...)
+_ASSIGN_RE = re.compile(
+    r"(?:\.|->)assign\(\s*reinterpret_cast<[^>]*>\(\s*(\w+)\s*\)")
+# a guard that proves the pointer was examined: if (k), if (!k), k ?,
+# k != nullptr, k == nullptr
+_GUARD_TEMPLATES = (
+    r"if\s*\(\s*!?\s*{p}\s*[)&|]",
+    r"\b{p}\s*\?",
+    r"\b{p}\s*[!=]=\s*nullptr",
+    r"\bnullptr\s*[!=]=\s*{p}\b",
+)
+
+_DEPTH_CAP_RE = re.compile(r"\bdepth\s*>=?\s*(\w+)")
+_CONST_DEF_RE = re.compile(
+    r"\bconstexpr\s+(?:int|size_t|unsigned|long)\s+(\w+)\s*=\s*(\d+)")
+
+
+def _brace_depth_per_line(lines):
+    """Cumulative brace depth AFTER each line (comments/strings are not
+    stripped — good enough for this codebase's formatting)."""
+    depth = 0
+    out = []
+    for text in lines:
+        # ignore braces in line comments
+        code = text.split("//", 1)[0]
+        depth += code.count("{") - code.count("}")
+        out.append(depth)
+    return out
+
+
+def check_na01(nf: NativeFile) -> list[Violation]:
+    """nullptr-reachable .assign(): a pointer initialised to nullptr in
+    the current function and passed to string::assign() without any
+    intervening null check. assign(nullptr, 0) is UB even though
+    mainstream stdlibs tolerate it."""
+    out = []
+    depths = _brace_depth_per_line(nf.lines)
+    tracked: dict = {}   # name -> (decl line 1-based, decl brace depth)
+    for i, text in enumerate(nf.lines):
+        lineno = i + 1
+        # drop pointers whose enclosing scope has closed
+        for name, (_dl, dd) in list(tracked.items()):
+            if depths[i] < dd:
+                tracked.pop(name)
+        for m in _NULLPTR_DECL_RE.finditer(text):
+            tracked[m.group(1)] = (lineno, depths[i])
+        for name in list(tracked):
+            if re.search(_REBIND_RE.pattern % re.escape(name), text) \
+                    and "nullptr" not in text:
+                # direct rebinding does not prove non-null (maybe(&k)
+                # style writes go through &k, which we keep tainted) —
+                # only drop the taint for `k = <expr>;` assignments
+                tracked.pop(name, None)
+        m = _ASSIGN_RE.search(text)
+        if not m:
+            continue
+        p = m.group(1)
+        if p not in tracked:
+            continue
+        decl = tracked[p][0]
+        window = "\n".join(nf.lines[decl - 1:lineno])
+        guarded = any(
+            re.search(t.format(p=re.escape(p)), window)
+            for t in _GUARD_TEMPLATES)
+        if not guarded:
+            out.append(Violation(
+                nf.path, lineno, "NA01",
+                f"`{p}` can still be nullptr here (initialised to "
+                f"nullptr on line {decl}, never null-checked) — "
+                ".assign(nullptr, n) is undefined behaviour; guard "
+                "the pointer"))
+    return out
+
+
+def check_na02(nf: NativeFile, ctx, config: dict) -> list[Violation]:
+    """Recursion-cap parity with the Python fallback decoder. The
+    depth cap in PbReader::skip must (a) be a named constant, not a
+    magic literal, and (b) equal the Python-side parity constant
+    (PB_SKIP_MAX_DEPTH in ssf/framing.py) so the two decoders draw the
+    fallback boundary at the same depth."""
+    out = []
+    consts = {}
+    for i, text in enumerate(nf.lines):
+        for m in _CONST_DEF_RE.finditer(text):
+            consts[m.group(1)] = (int(m.group(2)), i + 1)
+    py_name = config["na02_py_constant"]
+    for i, text in enumerate(nf.lines):
+        m = _DEPTH_CAP_RE.search(text.split("//", 1)[0])
+        if not m:
+            continue
+        lineno = i + 1
+        cap = m.group(1)
+        if cap.isdigit():
+            out.append(Violation(
+                nf.path, lineno, "NA02",
+                f"magic recursion cap {cap} — name it (constexpr) and "
+                f"mirror it as {py_name} beside the Python fallback "
+                "decoder so the parity boundary has one definition"))
+            continue
+        if cap not in consts:
+            continue   # named elsewhere (another TU); nothing to prove
+        value = consts[cap][0]
+        if ctx.na02_value is None:
+            out.append(Violation(
+                nf.path, lineno, "NA02",
+                f"recursion cap {cap}={value} has no Python-side "
+                f"{py_name} constant in the scanned tree — the native "
+                "and fallback decoders must share the boundary"))
+        elif ctx.na02_value != value:
+            out.append(Violation(
+                nf.path, lineno, "NA02",
+                f"recursion cap {cap}={value} diverges from "
+                f"{py_name}={ctx.na02_value} ({ctx.na02_path}) — the "
+                "native parser and the Python fallback decoder draw "
+                "the fallback boundary at different depths"))
+    return out
+
+
+def check_file(nf: NativeFile, ctx, config: dict) -> list[Violation]:
+    return check_na01(nf) + check_na02(nf, ctx, config)

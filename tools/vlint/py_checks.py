@@ -1,0 +1,636 @@
+"""Python AST passes: JX01, JX02, JX03, TH01, CF01.
+
+All checks are intentionally conservative: they resolve only what can
+be resolved statically within the project (local jit wrappers, module
+level donating jits reached through import aliases, intra-class call
+graphs) and stay silent where they cannot prove a binding. The goal is
+a zero-false-positive tier-1 gate, not exhaustive inference — the
+check-specific limits are documented in tools/vlint/README.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .core import (PyModule, Project, Violation, dotted, is_jit_expr,
+                   jit_call_keywords, literal_ints, literal_strs,
+                   param_names)
+
+_SYNC_SUFFIXES = ("device_get", "block_until_ready", "copy_to_host_async")
+_NP_LEAK_FNS = ("asarray", "array", "frombuffer", "fromiter")
+_CAST_BUILTINS = ("float", "int", "bool")
+
+
+@dataclass
+class Donating:
+    """A callable known to donate arguments: positional indices and/or
+    parameter names (either may be empty when unresolvable)."""
+    positions: tuple = ()
+    names: tuple = ()
+
+
+@dataclass
+class Context:
+    """Cross-module facts, built once per run."""
+    # method/function name -> parameter names (self/cls stripped) and
+    # the set of params that carry defaults; first definition wins
+    signatures: dict = field(default_factory=dict)
+    # module basename -> {module-level callable name -> Donating}
+    donating_modules: dict = field(default_factory=dict)
+    # NA02: value of the Python-side recursion-cap parity constant
+    na02_value: int | None = None
+    na02_path: str | None = None
+
+
+def _module_basename(path: str) -> str:
+    return os.path.splitext(os.path.basename(path))[0]
+
+
+def build_context(proj: Project, config: dict) -> Context:
+    ctx = Context()
+    const_name = config["na02_py_constant"]
+    for mod in proj.py_modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = param_names(node)
+                if params and params[0] in ("self", "cls"):
+                    params = params[1:]
+                ctx.signatures.setdefault(node.name, tuple(params))
+        ctx.donating_modules[_module_basename(mod.path)] = \
+            _module_donating(mod.tree)
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == const_name
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)):
+                ctx.na02_value = node.value.value
+                ctx.na02_path = mod.path
+    return ctx
+
+
+# ------------------------------------------------------------- jit discovery
+
+def _np_aliases(tree: ast.AST) -> set:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _partial_jit_aliases(tree: ast.AST) -> dict:
+    """Names bound to functools.partial(jax.jit, **kw): name -> the
+    partial's keywords (donation/static config ride along)."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            v = node.value
+            if dotted(v.func) in ("functools.partial", "partial") \
+                    and v.args and is_jit_expr(v.args[0]):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = list(v.keywords)
+    return out
+
+
+def _jitted_functions(tree: ast.AST):
+    """Every FunctionDef/Lambda the module jit-compiles: via decorator,
+    via jax.jit(fn, ...)/partial(jax.jit, ...)(fn) call, or via a
+    partial-jit alias applied to a def/lambda."""
+    defs_by_name: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    jitted = []
+    for fns in defs_by_name.values():
+        for fn in fns:
+            if any(is_jit_expr(dec) for dec in fn.decorator_list):
+                jitted.append(fn)
+    aliases = _partial_jit_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_jit_call = is_jit_expr(node.func)
+        is_alias_call = (isinstance(node.func, ast.Name)
+                         and node.func.id in aliases)
+        if not (is_jit_call or is_alias_call) or not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Lambda):
+            jitted.append(arg)
+        else:
+            d = dotted(arg)
+            if d is not None:
+                jitted.extend(defs_by_name.get(d.split(".")[-1], ()))
+    # dedupe, preserve order
+    seen, out = set(), []
+    for fn in jitted:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append(fn)
+    return out
+
+
+# ------------------------------------------------------------------- JX01
+
+def check_jx01(mod: PyModule) -> list[Violation]:
+    """Tracer leaks: host-forcing calls inside jit-compiled functions.
+    `.item()`/`.tolist()` and numpy materialisation are flagged
+    unconditionally; float()/int()/bool() only when their argument
+    references a traced parameter (static shape math like
+    int(math.ceil(...)) over closure config is legal and common)."""
+    out = []
+    np_names = _np_aliases(mod.tree)
+    flagged = set()
+    for fn in _jitted_functions(mod.tree):
+        params = set(param_names(fn))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in flagged:
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and not node.args \
+                    and f.attr in ("item", "tolist"):
+                flagged.add(key)
+                out.append(Violation(
+                    mod.path, node.lineno, "JX01",
+                    f".{f.attr}() inside a jitted function forces a "
+                    "host sync per trace and breaks under jit — "
+                    "compute on-device instead"))
+                continue
+            d = dotted(f)
+            if d and "." in d:
+                root, leaf = d.split(".", 1)[0], d.rsplit(".", 1)[-1]
+                if root in np_names and leaf in _NP_LEAK_FNS:
+                    flagged.add(key)
+                    out.append(Violation(
+                        mod.path, node.lineno, "JX01",
+                        f"{d}() materialises a tracer to host numpy "
+                        "inside a jitted function — use jnp"))
+                    continue
+            if isinstance(f, ast.Name) and f.id in _CAST_BUILTINS \
+                    and node.args:
+                refs = {n.id for a in node.args
+                        for n in ast.walk(a) if isinstance(n, ast.Name)}
+                if refs & params:
+                    flagged.add(key)
+                    out.append(Violation(
+                        mod.path, node.lineno, "JX01",
+                        f"{f.id}() applied to a traced argument inside "
+                        "a jitted function concretises the tracer — "
+                        "keep it as an array"))
+    return out
+
+
+# ------------------------------------------------------------------- JX02
+
+def _donating_from_assign(node: ast.Assign, defs_by_name: dict,
+                          aliases: dict) -> Donating | None:
+    """X = jax.jit(f, donate_*=...) / partial(jax.jit, donate_*=..)(f)
+    / alias(f) where alias is a partial-jit with donation."""
+    v = node.value
+    if not isinstance(v, ast.Call) or not v.args:
+        return None
+    kws = []
+    if is_jit_expr(v.func):
+        kws = list(v.keywords) + jit_call_keywords(v.func)
+    elif isinstance(v.func, ast.Name) and v.func.id in aliases:
+        kws = list(v.keywords) + list(aliases[v.func.id])
+    else:
+        return None
+    return _donation_of(kws, v.args[0], defs_by_name)
+
+
+def _donation_of(kws, wrapped, defs_by_name) -> Donating | None:
+    positions, names = [], []
+    for kw in kws:
+        if kw.arg == "donate_argnums":
+            positions.extend(literal_ints(kw.value) or ())
+        elif kw.arg == "donate_argnames":
+            names.extend(literal_strs(kw.value) or ())
+    if not positions and not names:
+        return None
+    # resolve names -> positions when the wrapped def is in reach
+    fn = None
+    if isinstance(wrapped, ast.Lambda):
+        fn = wrapped
+    else:
+        d = dotted(wrapped) if wrapped is not None else None
+        if d is not None:
+            cands = defs_by_name.get(d.split(".")[-1])
+            fn = cands[0] if cands else None
+    if fn is not None:
+        plist = param_names(fn)
+        for n in names:
+            if n in plist and plist.index(n) not in positions:
+                positions.append(plist.index(n))
+    return Donating(tuple(sorted(set(positions))), tuple(names))
+
+
+def _module_donating(tree: ast.AST) -> dict:
+    """Module-level callables that donate: decorated defs and
+    module-level assigns of donating jit wrappers."""
+    defs_by_name: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    aliases = _partial_jit_aliases(tree)
+    out: dict = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_expr(dec):
+                    don = _donation_of(jit_call_keywords(dec), node,
+                                       defs_by_name)
+                    if don:
+                        out[node.name] = don
+        elif isinstance(node, ast.Assign):
+            don = _donating_from_assign(node, defs_by_name, aliases)
+            if don:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = don
+    return out
+
+
+def _import_aliases(tree: ast.AST) -> dict:
+    """Local name -> imported module basename (for resolving
+    alias.func() against the cross-module donation table)."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                base = a.name.rsplit(".", 1)[-1]
+                out[a.asname or a.name.split(".")[0]] = base
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                out[a.asname or a.name] = a.name
+    return out
+
+
+def _parent_map(tree: ast.AST) -> dict:
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _enclosing(node, parents, kinds):
+    cur = parents.get(node)
+    while cur is not None and not isinstance(cur, kinds):
+        cur = parents.get(cur)
+    return cur
+
+
+def check_jx02(mod: PyModule, ctx: Context) -> list[Violation]:
+    """Donation-use-after-dispatch: an argument expression passed in a
+    donated position must not be read again in the same scope after the
+    call, unless the call statement itself rebinds it. Tracks local
+    wrappers (`f = jax.jit(g, donate_argnums=(0,))`), decorated defs,
+    and imported module-level donating jits (`tdigest.compress`)."""
+    tree = mod.tree
+    defs_by_name: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    aliases = _partial_jit_aliases(tree)
+    imports = _import_aliases(tree)
+    local: dict = dict(_module_donating(tree))
+    # function-local wrapper assigns (any depth), incl. self.attr targets
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            don = _donating_from_assign(node, defs_by_name, aliases)
+            if don:
+                for t in node.targets:
+                    d = dotted(t)
+                    if d:
+                        local[d] = don
+    # decorated defs at class level too
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_expr(dec):
+                    don = _donation_of(jit_call_keywords(dec), node,
+                                       defs_by_name)
+                    if don:
+                        local.setdefault(node.name, don)
+
+    parents = _parent_map(tree)
+    out = []
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        d = dotted(call.func)
+        don = None
+        callee_params = None
+        if d in local:
+            don = local[d]
+        elif d and "." in d:
+            root, leaf = d.split(".", 1)[0], d.rsplit(".", 1)[-1]
+            table = ctx.donating_modules.get(imports.get(root, ""))
+            if table and leaf in table:
+                don = table[leaf]
+                sig = ctx.signatures.get(leaf)
+                callee_params = list(sig) if sig else None
+        if don is None:
+            continue
+        donated_exprs = []
+        for pos in don.positions:
+            if pos < len(call.args):
+                donated_exprs.append(call.args[pos])
+        for name in don.names:
+            for kw in call.keywords:
+                if kw.arg == name:
+                    donated_exprs.append(kw.value)
+            if callee_params and name in callee_params:
+                i = callee_params.index(name)
+                if i < len(call.args) and i not in don.positions:
+                    donated_exprs.append(call.args[i])
+        for expr in donated_exprs:
+            target = dotted(expr)
+            if target is None:
+                continue
+            v = _read_after_donation(call, target, parents)
+            if v is not None:
+                out.append(Violation(
+                    mod.path, v, "JX02",
+                    f"`{target}` was donated to `{d}` and is read "
+                    "again before being rebound — the buffer is dead "
+                    "after dispatch (donate_argnums)"))
+    # dedupe
+    seen, uniq = set(), []
+    for v in out:
+        k = (v.line, v.message)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(v)
+    return uniq
+
+
+def _read_after_donation(call, target: str, parents) -> int | None:
+    """Line of the first read of `target` after `call` in the enclosing
+    scope, before any rebinding store. None if rebound first (or the
+    call statement itself rebinds it)."""
+    stmt = _enclosing(call, parents, ast.stmt)
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        if any(dotted(t) == target for t in targets):
+            return None   # rebound by the dispatch statement
+    scope = _enclosing(call, parents,
+                       (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Lambda, ast.Module))
+    if scope is None:
+        return None
+    call_end = (call.end_lineno, call.end_col_offset)
+    call_start = (call.lineno, call.col_offset)
+    events = []
+    for node in ast.walk(scope):
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        d = dotted(node)
+        if d is None:
+            continue
+        pos = (node.lineno, node.col_offset)
+        if call_start <= pos <= call_end:
+            continue   # part of the dispatch expression itself
+        if isinstance(node.ctx, ast.Store):
+            if d == target:
+                events.append((pos, "store"))
+        elif isinstance(node.ctx, ast.Load):
+            if d == target or d.startswith(target + "."):
+                events.append((pos, "load"))
+    events.sort()
+    for pos, kind in events:
+        if pos <= call_end:
+            continue
+        if kind == "store":
+            return None
+        return pos[0]
+    return None
+
+
+# ------------------------------------------------------------------- JX03
+
+def check_jx03(mod: PyModule, config: dict) -> list[Violation]:
+    """Host synchronisation outside the flush/fetch layer. device_get /
+    block_until_ready / copy_to_host_async stall the dispatch pipeline
+    (and on relayed backends invalidate the serving executable); every
+    legitimate sync point lives in the allowlisted modules or carries an
+    inline suppression explaining itself."""
+    if any(mod.path.endswith(a) for a in config["jx03_allow"]):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d and d.rsplit(".", 1)[-1] in _SYNC_SUFFIXES:
+            fn = d.rsplit(".", 1)[-1]
+            out.append(Violation(
+                mod.path, node.lineno, "JX03",
+                f"{fn}() outside the flush/fetch modules — host sync "
+                "in serving code stalls the dispatch pipeline; move it "
+                "behind the engine's flush_fetch path or suppress with "
+                "a reason"))
+    return out
+
+
+# ------------------------------------------------------------------- TH01
+
+def _lockish(expr: ast.AST) -> bool:
+    d = dotted(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+    return bool(d) and "lock" in d.lower()
+
+
+def check_th01(mod: PyModule, config: dict) -> list[Violation]:
+    """Unguarded shared-state writes: in the threaded server files, a
+    method reachable from two or more thread roots (thread targets +
+    public entry points) must hold a lock around writes to self.*
+    state. Methods named *_locked run under the caller's lock by
+    project convention."""
+    if os.path.basename(mod.path) not in config["th01_files"]:
+        return []
+    out = []
+    suffixes = tuple(config["th01_locked_suffixes"])
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        edges: dict = {m: set() for m in methods}
+        targets = set()
+        for mname, fn in methods.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d and d.startswith("self.") and \
+                        d.count(".") == 1 and d[5:] in methods:
+                    edges[mname].add(d[5:])
+                if d and d.rsplit(".", 1)[-1] == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            td = dotted(kw.value)
+                            if td and td.startswith("self.") \
+                                    and td[5:] in methods:
+                                targets.add(td[5:])
+        roots = targets | {m for m in methods if not m.startswith("_")}
+        reached_by: dict = {m: set() for m in methods}
+        for root in roots:
+            stack, seen = [root], set()
+            while stack:
+                cur = stack.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                reached_by[cur].add(root)
+                stack.extend(edges.get(cur, ()))
+        for mname, fn in methods.items():
+            if mname == "__init__" or mname.endswith(suffixes):
+                continue
+            if len(reached_by[mname]) < 2:
+                continue
+            out.extend(_th01_writes(mod.path, mname, fn))
+    return out
+
+
+def _th01_writes(path: str, mname: str, fn: ast.FunctionDef
+                 ) -> list[Violation]:
+    out = []
+
+    def self_attr_of(t):
+        """self.X or self.X[...] target -> attribute name X."""
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Name) and t.value.id == "self":
+            return t.attr
+        return None
+
+    def visit(node, locked):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            locked = locked or any(_lockish(item.context_expr)
+                                   for item in node.items)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = self_attr_of(t)
+                if attr is not None and not locked:
+                    out.append(Violation(
+                        path, node.lineno, "TH01",
+                        f"write to self.{attr} in `{mname}` — the "
+                        "method is reachable from multiple threads "
+                        "and the write is not under a lock"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    visit(fn, False)
+    return out
+
+
+# ------------------------------------------------------------------- CF01
+
+def _cfg_fields(expr: ast.AST) -> set:
+    """cfg field names referenced by an expression: cfg.X / self.cfg.X /
+    anything.cfg.X."""
+    out = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            base = dotted(node.value)
+            if base is not None and (base == "cfg"
+                                     or base.endswith(".cfg")):
+                out.add(node.attr)
+    return out
+
+
+def check_cf01(mod: PyModule, ctx: Context, config: dict
+               ) -> list[Violation]:
+    """Config-plumbing parity: within a sibling family (same receiver,
+    same method-name prefix), a cfg-derived value passed for parameter
+    P at one call site must be passed at every sibling whose signature
+    also accepts P — the exact class of the start_ssf_udp rcvbuf bug."""
+    prefixes = tuple(config["cf01_prefixes"])
+    groups: dict = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        recv = dotted(node.func.value)
+        mname = node.func.attr
+        if recv is None or mname.split("_")[0] not in prefixes:
+            continue
+        groups.setdefault((recv, mname.split("_")[0]), []).append(node)
+
+    out = []
+    for (recv, _prefix), calls in groups.items():
+        if len(calls) < 2:
+            continue
+        bound = []   # (call, mname, params, {param: cfg_fields})
+        for call in calls:
+            mname = call.func.attr
+            sig = ctx.signatures.get(mname)
+            if sig is None:
+                continue
+            params = list(sig)
+            binding: dict = {}
+            for i, a in enumerate(call.args):
+                if i < len(params):
+                    f = _cfg_fields(a)
+                    if f:
+                        binding[params[i]] = f
+            explicit = {params[i] for i in range(min(len(call.args),
+                                                     len(params)))}
+            for kw in call.keywords:
+                if kw.arg is not None:
+                    explicit.add(kw.arg)
+                    f = _cfg_fields(kw.value)
+                    if f:
+                        binding[kw.arg] = f
+            bound.append((call, mname, params, explicit, binding))
+        for (ca, na, pa, ea, ba) in bound:
+            for param, fields in ba.items():
+                for (cb, nb, pb, eb, _bb) in bound:
+                    if cb is ca or param not in pb or param in eb:
+                        continue
+                    fld = ",".join(sorted(fields))
+                    out.append(Violation(
+                        mod.path, cb.lineno, "CF01",
+                        f"sibling `{recv}.{na}` passes cfg.{fld} as "
+                        f"`{param}` but `{nb}` leaves it at its "
+                        "default — config plumbing must reach every "
+                        "sibling listener"))
+    # dedupe (two siblings can each accuse the same omission)
+    seen, uniq = set(), []
+    for v in out:
+        k = (v.line, v.message)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(v)
+    return uniq
+
+
+# ------------------------------------------------------------------- driver
+
+def check_module(mod: PyModule, ctx: Context, config: dict
+                 ) -> list[Violation]:
+    out = []
+    out.extend(check_jx01(mod))
+    out.extend(check_jx02(mod, ctx))
+    out.extend(check_jx03(mod, config))
+    out.extend(check_th01(mod, config))
+    out.extend(check_cf01(mod, ctx, config))
+    return out

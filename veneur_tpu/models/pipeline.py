@@ -285,7 +285,11 @@ def stage_copy_executable(sharding=None):
 # says they're needed (full-precision twins + 2Sum lo terms)
 _LAZY_KEYS = ("q32", "lp32", "lo_count", "lo_sum")
 _F16_SAT = 61440.0      # |x| beyond this rounds into f16's overflow zone
-_F16_TINY = 6.1e-5      # below f16's min normal: relative precision lost
+# f16 min normal (2^-14 exactly): below this, values encode as f16
+# subnormals with reduced relative precision, so the sentinel must sit
+# AT the boundary — 6.1e-5 (the old value) left a [6.1e-5, 2^-14) band
+# that skipped the full-precision refetch yet lost precision on the wire
+_F16_TINY = 2.0 ** -14
 
 
 def fetch_flush_outputs(out, mode: str, stage_exec=None):
@@ -681,19 +685,31 @@ class AggregationEngine:
         slots = np.asarray(slots)
         B = self.histo_bank.buf_size
         valid = slots >= 0
-        # bincount, not np.unique: this check runs on EVERY pump batch,
-        # and unique's O(n log n) host sort would dominate a sub-ms TPU
-        # dispatch; bincount is one O(n + K) pass
         vs = slots[valid]
-        cnt = np.bincount(vs, minlength=1) if vs.size else np.zeros(
-            1, np.int64)
-        if cnt.max() <= B:
+        # Hot-slot detection, cheapest-first (this runs on EVERY pump
+        # batch): a batch with <= B valid rows cannot overfill any slot,
+        # so skip counting entirely. Otherwise bincount — one O(n + max)
+        # pass — EXCEPT when the live slot ids dwarf the batch (sparse
+        # high-slot batches against a 1M-slot bank would allocate and
+        # scan a multi-MB count array per batch); there np.unique's
+        # O(n log n) on the small batch is the cheaper form.
+        if vs.size <= B:
+            self.histo_bank = self._kern["histo"](
+                self.histo_bank, slots, values, weights)
+            return
+        if vs.max() > 16 * vs.size:
+            uniq, cnt = np.unique(vs, return_counts=True)
+            hot_ids = uniq[cnt > B]
+        else:
+            cnt = np.bincount(vs, minlength=1)
+            hot_ids = np.nonzero(cnt > B)[0]
+        if hot_ids.size == 0:
             self.histo_bank = self._kern["histo"](
                 self.histo_bank, slots, values, weights)
             return
         values = np.asarray(values)
         weights = np.asarray(weights)
-        hot = set(np.nonzero(cnt > B)[0].tolist())
+        hot = set(hot_ids.tolist())
         hot_m = np.isin(slots, list(hot)) & valid
         cold_slots = np.where(hot_m, -1, slots).astype(np.int32)
         self.histo_bank = self._kern["histo"](

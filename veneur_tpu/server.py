@@ -669,6 +669,7 @@ class Server:
                 self.ssf_native_port = self.native_bridge.start_ssf_udp(
                     bind_addr[0], bind_addr[1],
                     n_readers=max(1, self.cfg.num_readers),
+                    rcvbuf=self.cfg.read_buffer_size_bytes,
                     max_dgram=self.cfg.trace_max_length_bytes)
                 log.info("native SSF listener on udp://%s:%d",
                          bind_addr[0], self.ssf_native_port)
@@ -1032,7 +1033,8 @@ class Server:
                 log.exception("forward failed")
                 if self._sentry is not None:
                     self._sentry.capture(e, "forward failed")
-        self.flush_count += 1
+        with self._stats_lock:
+            self.flush_count += 1
         return frameset
 
     def _self_metrics(self, ts: int, t0: float,
@@ -1070,6 +1072,9 @@ class Server:
                 eng_stats["dropped_no_slot"] = (
                     int(st["drops_no_slot"])
                     - int(last.get("drops_no_slot", 0)))
+            # vlint: disable=TH01 reason=flush-path-only state; flushes
+            # are serialized (one flusher thread, tests call flush_once
+            # synchronously), so no concurrent writer exists
             self._last_bridge_stats = st
         dur_ns = (time.monotonic() - t0) * 1e9
         mk = lambda name, value, mt, tags=(): InterMetric(
@@ -1153,6 +1158,8 @@ class Server:
                                  name=f"{key[0]}-{key[1]}")
             # register BEFORE start so stop()'s drain can never miss an
             # in-flight sink; stop() tolerates the not-yet-started window
+            # vlint: disable=TH01 reason=flusher-thread-only map; stop()
+            # only reads it after _stop is set and the last tick ended
             self._sink_inflight[key] = t
             t.start()
 

@@ -26,6 +26,13 @@ _LEN = struct.Struct("<I")
 # attacker-controlled buffer sizes.
 MAX_FRAME_LENGTH = 16 * 1024 * 1024
 
+# Parity boundary shared with native/vtpu_ingest.cpp (kPbSkipMaxDepth,
+# enforced by vlint NA02): the native parser skips unknown-field groups
+# only to this nesting depth — anything deeper falls back to THIS
+# module's decoder (the google.protobuf runtime, whose own recursion
+# limit is far larger), so the two paths accept the same datagrams.
+PB_SKIP_MAX_DEPTH = 16
+
 
 class FramingError(ValueError):
     """Bad frame (version, length, or protobuf decode)."""
