@@ -14,11 +14,24 @@ off the tunnel. Re-set the config here, before any backend initializes.
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from veneur_tpu.utils.platform import pin_cpu  # noqa: E402
 
 pin_cpu(8)
+
+
+@pytest.fixture
+def fault_harness():
+    """Deterministic egress fault injection (utils/faults.py): a shared
+    FakeClock + scripted transports + pre-wired Egress factory, so
+    retry/breaker/re-merge transitions are asserted without sockets or
+    real sleeps."""
+    from veneur_tpu.utils.faults import FaultHarness
+
+    return FaultHarness(seed=0)
 
 # The fused flush program's donation warnings ("Some donated buffers
 # were not usable" — unused donated buffers are simply freed, which is

@@ -222,9 +222,17 @@ def test_discovering_forwarder_rotates_and_refreshes():
         def get_destinations_for_service(self, service):
             raise OSError("consul down")
 
+    import pytest
+
+    from veneur_tpu.resilience import TransientEgressError
+
     fwd2 = DiscoveringForwarder(Flaky(), "svc", refresh_interval_s=0.0,
                                 forwarder_factory=FakeFwd)
-    fwd2(None)  # must not raise
+    # a discovery outage with no known destinations raises (transient)
+    # so the server's ResilientForwarder spills the export for re-merge
+    # instead of silently dropping the interval
+    with pytest.raises(TransientEgressError):
+        fwd2(None)
     assert fwd2.errors >= 1
 
 

@@ -51,7 +51,7 @@ def test_datadog_frame_flush_byte_identical():
         sink = DatadogMetricSink(api_key="k", api_url="http://x",
                                  hostname="fallback", tags=["base:tag"],
                                  interval_s=10)
-        sink._post = lambda path, body: bodies.append((path, body))
+        sink._post = lambda path, body, deadline=None: bodies.append((path, body))
         return sink
 
     fs = build_frameset()
@@ -79,7 +79,7 @@ def test_datadog_chunking_matches():
         sink = DatadogMetricSink(api_key="k", api_url="http://x",
                                  hostname="h", interval_s=10,
                                  flush_max_per_body=4)
-        sink._post = lambda path, body: bodies.append(
+        sink._post = lambda path, body, deadline=None: bodies.append(
             len(body["series"]))
         return sink
 

@@ -311,7 +311,7 @@ def test_key_churn_soak_bounded_state():
         histogram_slots=2048, counter_slots=2048, gauge_slots=128,
         set_slots=64, buffer_depth=128, idle_ttl_intervals=4))
     sink = DatadogMetricSink(api_key="x", interval_s=10)
-    sink._post = lambda path, body: None  # capture nothing, reach no API
+    sink._post = lambda path, body, deadline=None: None  # no real API
     dropped_total = 0
     for interval in range(40):
         for j in range(300):  # fresh names every interval -> full churn

@@ -51,6 +51,17 @@ def test_na02_cap_diverges_from_python_constant():
     assert lint("na02_diverge.cpp", "na02_parity.py") == [("NA02", 7)]
 
 
+def test_rs01_raw_egress_bypasses_resilience():
+    # one urlopen + one grpc channel construction, exact lines
+    assert lint("rs01_bad.py") == [("RS01", 9), ("RS01", 14)]
+
+
+def test_rs01_allows_the_resilience_layer_itself():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "resilience.py")
+    assert [v for v in run_paths([path]) if v.rule == "RS01"] == []
+
+
 def test_clean_fixture_is_clean():
     assert lint("clean.py") == []
 

@@ -29,4 +29,9 @@ DEFAULT_CONFIG = {
     # NA02: the Python-side parity constant for the native decoder's
     # recursion cap.
     "na02_py_constant": "PB_SKIP_MAX_DEPTH",
+    # RS01: modules allowed to make raw urlopen / grpc-channel calls —
+    # the resilience layer itself owns the one raw transport.
+    "rs01_allow": (
+        "veneur_tpu/resilience.py",
+    ),
 }

@@ -1,4 +1,4 @@
-"""Python AST passes: JX01, JX02, JX03, TH01, CF01.
+"""Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01.
 
 All checks are intentionally conservative: they resolve only what can
 be resolved statically within the project (local jit wrappers, module
@@ -623,6 +623,46 @@ def check_cf01(mod: PyModule, ctx: Context, config: dict
     return uniq
 
 
+# ------------------------------------------------------------------- RS01
+
+_RS01_GRPC_LEAVES = ("insecure_channel", "secure_channel")
+
+
+def check_rs01(mod: PyModule, config: dict) -> list[Violation]:
+    """Raw egress bypassing the resilience layer: a direct
+    urllib.request.urlopen call or grpc channel construction anywhere
+    but `veneur_tpu/resilience.py` (the layer's own transport) skips
+    the retry/backoff/circuit-breaker treatment every network egress
+    must receive. Route HTTP through Egress.post/fetch and channels
+    through resilience.grpc_channel; intentional raw calls (e.g. the
+    crash-path sentry reporter) carry an inline suppression."""
+    if any(mod.path.endswith(a) for a in config["rs01_allow"]):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        leaf = d.rsplit(".", 1)[-1]
+        if leaf == "urlopen":
+            out.append(Violation(
+                mod.path, node.lineno, "RS01",
+                "raw urlopen() bypasses the egress-resilience layer "
+                "(no retry/backoff, no circuit breaker, no deadline "
+                "budget) — route through resilience.Egress.post/fetch "
+                "or suppress with a reason"))
+        elif leaf in _RS01_GRPC_LEAVES and (d == leaf
+                                            or d.startswith("grpc.")):
+            out.append(Violation(
+                mod.path, node.lineno, "RS01",
+                f"raw {leaf}() bypasses the egress-resilience layer — "
+                "create channels via resilience.grpc_channel (and wrap "
+                "calls in Egress.call) or suppress with a reason"))
+    return out
+
+
 # ------------------------------------------------------------------- driver
 
 def check_module(mod: PyModule, ctx: Context, config: dict
@@ -633,4 +673,5 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_jx03(mod, config))
     out.extend(check_th01(mod, config))
     out.extend(check_cf01(mod, ctx, config))
+    out.extend(check_rs01(mod, config))
     return out

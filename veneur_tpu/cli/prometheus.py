@@ -96,6 +96,9 @@ def to_statsd_lines(samples, prev: dict, prefix: str = "",
 
 def poll_once(url: str, prev: dict, prefix: str = "",
               timeout_s: float = 10.0):
+    # vlint: disable=RS01 reason=scrape ingest in a one-shot CLI, not
+    # server egress: the poll loop already tolerates a failed scrape
+    # (skips the interval) and retrying inside would skew counter deltas
     with urllib.request.urlopen(url, timeout=timeout_s) as resp:
         text = resp.read().decode("utf-8", "replace")
     return to_statsd_lines(parse_exposition(text), prev, prefix)

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import json
 import logging
-import urllib.request
 from typing import Protocol
 
 log = logging.getLogger("veneur_tpu.cluster.discovery")
@@ -31,17 +30,27 @@ class StaticDiscoverer:
 
 class ConsulDiscoverer:
     """Query Consul's health API for passing instances
-    (GET /v1/health/service/<name>?passing)."""
+    (GET /v1/health/service/<name>?passing). Queries ride the
+    resilience layer (Consul agent restarts are routine) with a short
+    retry ladder — callers already tolerate a failed refresh by keeping
+    the previous destination set."""
 
     def __init__(self, consul_url: str = "http://127.0.0.1:8500",
-                 timeout_s: float = 5.0):
+                 timeout_s: float = 5.0, egress=None):
+        from ..resilience import (BreakerPolicy, Egress, EgressPolicy,
+                                  RetryPolicy)
         self.base = consul_url.rstrip("/")
         self.timeout_s = timeout_s
+        self._egress = egress or Egress(
+            self.base, policy=EgressPolicy(
+                retry=RetryPolicy(max_attempts=2, base_backoff_s=0.1,
+                                  max_backoff_s=1.0, deadline_s=5.0),
+                breaker=BreakerPolicy()))
 
     def get_destinations_for_service(self, service: str) -> list[str]:
         url = f"{self.base}/v1/health/service/{service}?passing"
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
-            entries = json.load(resp)
+        entries = json.loads(
+            self._egress.fetch(url, timeout_s=self.timeout_s))
         out = []
         for e in entries:
             svc = e.get("Service", {})

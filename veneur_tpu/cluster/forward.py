@@ -3,6 +3,12 @@
 Parity: flusher.go (sym: Server.forwardGRPC) for the gRPC path and the
 legacy HTTP POST /import path (sym: Server.flushForward) — here JSON
 instead of Go gob, same payload semantics.
+
+Both forwarders route their wire calls through a per-destination
+`resilience.Egress` (retry with full-jitter backoff, circuit breaker,
+per-flush deadline budget); terminal failures propagate so the
+server-side `ResilientForwarder` can spill the interval's sketches for
+re-merge instead of dropping them.
 """
 
 from __future__ import annotations
@@ -11,9 +17,9 @@ import json
 import logging
 import urllib.request
 
-import grpc
-
 from ..models.pipeline import ForwardExport
+from ..resilience import (Egress, EgressPolicy, PartialDeliveryError,
+                          grpc_channel)
 from . import wire
 from .protos import forward_pb2
 
@@ -28,29 +34,74 @@ class GrpcForwarder:
     upstream over the forwardrpc contract."""
 
     def __init__(self, address: str, timeout_s: float = 10.0,
-                 max_per_batch: int = 10_000):
+                 max_per_batch: int = 10_000,
+                 egress: Egress | None = None,
+                 egress_policy: EgressPolicy | None = None):
         self.address = address
         self.timeout_s = timeout_s
         self.max_per_batch = max_per_batch
-        self._channel = grpc.insecure_channel(address)
+        self._egress = egress or Egress(f"grpc://{address}",
+                                        policy=egress_policy)
+        self._channel = grpc_channel(address)
         self._send = self._channel.unary_unary(
             SEND_METRICS,
             request_serializer=forward_pb2.MetricList.SerializeToString,
             response_deserializer=forward_pb2.Empty.FromString)
 
     def __call__(self, export: ForwardExport):
-        self.send_metrics(wire.export_to_metrics(export))
+        """Multi-batch exports fail PRECISELY: a terminal failure after
+        some batches landed raises PartialDeliveryError carrying only
+        the unsent tail, so the spill/re-merge layer cannot re-send
+        (and double-count) what the receiver already Combined. All
+        batches share ONE deadline budget — N batches cannot stall the
+        flush tick for N x retry_deadline."""
+        metrics = wire.export_to_metrics(export)
+        deadline = self._egress.deadline()
+        for i in range(0, len(metrics), self.max_per_batch):
+            batch = forward_pb2.MetricList(
+                metrics=metrics[i:i + self.max_per_batch])
+            try:
+                self._egress.call(self._send, batch,
+                                  timeout_s=self.timeout_s,
+                                  deadline=deadline)
+            except Exception as e:
+                if i == 0:
+                    raise    # nothing delivered: spill the whole export
+                raise PartialDeliveryError(
+                    _export_tail(export, i), e) from e
 
     def send_metrics(self, metrics: list):
-        """Ship raw metricpb.Metrics (used by the proxy's re-batching)."""
+        """Ship raw metricpb.Metrics (used by the proxy's re-batching),
+        batches retried under one shared deadline budget."""
+        deadline = self._egress.deadline()
         for i in range(0, len(metrics), self.max_per_batch):
-            self._send(
-                forward_pb2.MetricList(
-                    metrics=metrics[i:i + self.max_per_batch]),
-                timeout=self.timeout_s)
+            batch = forward_pb2.MetricList(
+                metrics=metrics[i:i + self.max_per_batch])
+            self._egress.call(self._send, batch,
+                              timeout_s=self.timeout_s,
+                              deadline=deadline)
 
     def close(self):
         self._channel.close()
+
+
+def _export_tail(export: ForwardExport, start: int) -> ForwardExport:
+    """Entries `start`.. of the export in wire order — metric i of
+    export_to_metrics corresponds 1:1 to the concatenation of
+    (histograms, sets, counters, gauges), so the unsent tail of the
+    metric list maps back to an export exactly."""
+    out = ForwardExport()
+    pos = 0
+    for entries, taker in ((export.histograms, out.histograms),
+                           (export.sets, out.sets),
+                           (export.counters, out.counters),
+                           (export.gauges, out.gauges)):
+        if start <= pos:
+            taker.extend(entries)
+        elif start < pos + len(entries):
+            taker.extend(entries[start - pos:])
+        pos += len(entries)
+    return out
 
 
 class HttpJsonForwarder:
@@ -70,9 +121,12 @@ class HttpJsonForwarder:
 
     FORMAT = "jsonmetric-v1"
 
-    def __init__(self, base_url: str, timeout_s: float = 10.0):
+    def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 egress: Egress | None = None,
+                 egress_policy: EgressPolicy | None = None):
         self.url = base_url.rstrip("/") + "/import"
         self.timeout_s = timeout_s
+        self._egress = egress or Egress(self.url, policy=egress_policy)
 
     def __call__(self, export: ForwardExport):
         body = []
@@ -104,9 +158,7 @@ class HttpJsonForwarder:
             headers={"Content-Type": "application/json",
                      "X-Veneur-Forward-Version": self.FORMAT},
             method="POST")
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            if resp.status >= 400:
-                raise RuntimeError(f"forward POST: HTTP {resp.status}")
+        self._egress.post(req, timeout_s=self.timeout_s)
 
 
 class DiscoveringForwarder:
@@ -114,17 +166,21 @@ class DiscoveringForwarder:
     (consul_forward_service_name + consul_refresh_interval in config.go;
     Server.RefreshDestinations). Destinations are re-resolved lazily
     once per refresh interval; flushes rotate through the healthy set so
-    a fleet of locals spreads load across the global tier."""
+    a fleet of locals spreads load across the global tier. Each
+    destination's forwarder carries its own breaker, so one dead global
+    is skipped cheaply while its peers keep receiving."""
 
     def __init__(self, discoverer, service: str,
                  refresh_interval_s: float = 30.0, use_grpc: bool = True,
-                 forwarder_factory=None):
+                 forwarder_factory=None, timeout_s: float = 10.0,
+                 egress_policy: EgressPolicy | None = None):
         self.discoverer = discoverer
         self.service = service
         self.refresh_interval_s = refresh_interval_s
         if forwarder_factory is None:
-            forwarder_factory = (GrpcForwarder if use_grpc
-                                 else HttpJsonForwarder)
+            leaf = GrpcForwarder if use_grpc else HttpJsonForwarder
+            forwarder_factory = lambda dest: leaf(  # noqa: E731
+                dest, timeout_s=timeout_s, egress_policy=egress_policy)
         self.factory = forwarder_factory
         self._dests: list[str] = []
         self._fwds: dict = {}
@@ -149,15 +205,26 @@ class DiscoveringForwarder:
             log.info("forward destinations for %s: %s", self.service,
                      dests)
             self._dests = dests
-            self._fwds = {d: f for d, f in self._fwds.items()
-                          if d in dests}
+            for d in [d for d in self._fwds if d not in dests]:
+                fw = self._fwds.pop(d)
+                close = getattr(fw, "close", None)
+                if close is not None:
+                    try:   # a departed gRPC dest must not leak a channel
+                        close()
+                    except Exception:
+                        pass
 
     def __call__(self, export):
         self._refresh()
         if not self._dests:
             self.errors += 1
             log.warning("no forward destinations for %s", self.service)
-            return
+            # raise instead of silently dropping the interval: the
+            # ResilientForwarder wrapping this spills the export and
+            # re-merges it once discovery recovers
+            from ..resilience import TransientEgressError
+            raise TransientEgressError(
+                f"no forward destinations for {self.service}")
         dest = self._dests[self._rr % len(self._dests)]
         self._rr += 1
         fwd = self._fwds.get(dest)

@@ -190,12 +190,16 @@ class ProxyServer:
 
 class _JsonDest:
     """POST a JSONMetric batch to one destination's /import
-    (the HTTP fan-out arm of proxy.go sym: Proxy.ProxyMetrics)."""
+    (the HTTP fan-out arm of proxy.go sym: Proxy.ProxyMetrics).
+    Each destination carries its own breaker via its Egress."""
 
-    def __init__(self, dest: str, timeout_s: float = 10.0):
+    def __init__(self, dest: str, timeout_s: float = 10.0,
+                 egress=None):
+        from ..resilience import Egress
         base = dest if "://" in dest else f"http://{dest}"
         self.url = base.rstrip("/") + "/import"
         self.timeout_s = timeout_s
+        self._egress = egress or Egress(self.url)
 
     def send_json(self, dicts: list):
         import json as _json
@@ -205,9 +209,7 @@ class _JsonDest:
             headers={"Content-Type": "application/json",
                      "X-Veneur-Forward-Version": "jsonmetric-v1"},
             method="POST")
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            if resp.status >= 400:
-                raise RuntimeError(f"proxy POST: HTTP {resp.status}")
+        self._egress.post(req, timeout_s=self.timeout_s)
 
 
 class HttpProxyFront:

@@ -69,6 +69,26 @@ class Config:
     consul_forward_service_name: str = ""
     consul_refresh_interval: str = "30s"
 
+    # --- egress resilience (veneur_tpu/resilience.py) ---
+    # Per-attempt socket timeout for every network egress (sinks +
+    # forwarders); the reference hardcoded 10s per client.
+    flush_timeout: str = "10s"
+    retry_max_attempts: int = 3
+    retry_backoff_base: str = "200ms"   # full-jitter: U(0, base*2^k)
+    retry_backoff_cap: str = "5s"
+    # per-flush, per-destination wall budget covering attempts, socket
+    # timeouts AND backoff sleeps — one wedged vendor can't eat the tick
+    retry_deadline: str = "8s"
+    # consecutive failed DELIVERIES (each a full retry ladder) -> open;
+    # the breaker records a call's final outcome, not per-attempt errors
+    breaker_failure_threshold: int = 5
+    breaker_open_duration: str = "30s"   # open -> half-open cooldown
+    breaker_half_open_successes: int = 1  # probes to close again
+    # re-merge spill buffer: failed forwards' sketches held for the next
+    # interval (lossless for counters/histos/sets; gauges age out)
+    spill_max_sketches: int = 65536
+    spill_gauge_max_age_intervals: int = 4
+
     # --- TLS (statsd/SSF stream listeners) ---
     tls_key: str = ""
     tls_certificate: str = ""
@@ -157,6 +177,10 @@ class Config:
     def consul_refresh_seconds(self) -> float:
         return _parse_interval(self.consul_refresh_interval)
 
+    @property
+    def flush_timeout_seconds(self) -> float:
+        return _parse_interval(self.flush_timeout)
+
 
 _FIELDS = {f.name: f for f in fields(Config)}
 
@@ -211,6 +235,22 @@ def _validate(cfg: Config) -> None:
             "it); typical deployments use 3-4", len(cfg.percentiles))
     if cfg.interval_seconds <= 0:
         raise ValueError(f"interval must be positive: {cfg.interval!r}")
+    for key in ("flush_timeout", "retry_backoff_base",
+                "retry_backoff_cap", "retry_deadline",
+                "breaker_open_duration"):
+        if _parse_interval(getattr(cfg, key)) <= 0:
+            raise ValueError(
+                f"{key} must be a positive duration: "
+                f"{getattr(cfg, key)!r}")
+    for key in ("retry_max_attempts", "breaker_failure_threshold",
+                "breaker_half_open_successes"):
+        if getattr(cfg, key) < 1:
+            raise ValueError(f"{key} must be >= 1")
+    if cfg.spill_max_sketches < 0 or \
+            cfg.spill_gauge_max_age_intervals < 0:
+        raise ValueError(
+            "spill_max_sketches / spill_gauge_max_age_intervals "
+            "must be >= 0")
     unknown = [a for a in cfg.aggregates
                if a not in _KNOWN_AGGREGATES]
     if unknown:

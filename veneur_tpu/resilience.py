@@ -1,0 +1,635 @@
+"""Unified egress resilience: retry, circuit breaking, sketch re-merge.
+
+Every network egress in the pipeline — the vendor sinks, both cluster
+forwarders, the proxy fan-out — routes its wire calls through an
+`Egress` from this module instead of raw urllib/grpc (vlint RS01
+enforces this). The layer owns three behaviors the call sites used to
+lack:
+
+  * **Retry with full-jitter exponential backoff** under a per-flush
+    deadline budget: a transient failure (timeout, 5xx, connection
+    refused, UNAVAILABLE) is retried up to `max_attempts` times with
+    `delay ~ U(0, min(cap, base * 2^attempt))`, and the whole call —
+    attempts plus backoff sleeps plus per-attempt socket timeouts —
+    never exceeds `deadline_s`, so one wedged vendor cannot push the
+    flush tick late.
+
+  * **A per-destination circuit breaker**, so a dead endpoint costs one
+    fast rejection per flush instead of a full retry ladder:
+
+        closed ──(failure_threshold consecutive failed calls)──▶ open
+          ▲                                                   │
+          │                              (open_duration_s elapses)
+          │                                                   ▼
+          └──(half_open_successes probe successes)──── half-open
+                       half-open ──(probe failure)──▶ open (timer
+                                                      restarts)
+
+    Half-open admits ONE in-flight probe at a time; concurrent callers
+    are rejected until the probe resolves.
+
+  * **A bounded re-merge spill buffer** (`SpillBuffer` +
+    `ResilientForwarder`): when a forward fails terminally, the
+    interval's `ForwardExport` sketches are NOT dropped — they are
+    spilled and merged into the next interval's export. t-digest
+    centroids concatenate (the receiver's Combine re-clusters), HLL
+    registers fold by max, counters sum: all lossless. Gauges are
+    last-write-wins and only meaningful fresh, so they ride along for
+    `gauge_max_age_intervals` failed intervals and are then evicted
+    (counted). The budget bounds total spilled entries; overflow evicts
+    oldest sketches first, also counted.
+
+Everything observable is counted per destination in a
+`ResilienceRegistry`; the server drains it each flush into
+`veneur.resilience.*_total` self-metrics. The clock, sleep, RNG, and
+transport are all injectable, so `utils/faults.py` can script every
+retry/breaker/re-merge transition deterministically — no sockets, no
+real sleeps.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+
+import numpy as np
+
+log = logging.getLogger("veneur_tpu.resilience")
+
+
+# --------------------------------------------------------------- errors
+
+class EgressError(Exception):
+    """Base for resilience-layer errors."""
+
+
+class TransientEgressError(EgressError):
+    """Marker for failures the retry loop should retry."""
+
+
+class TerminalEgressError(EgressError):
+    """Marker for failures that must not be retried."""
+
+
+class CircuitOpenError(EgressError):
+    """The destination's breaker is open; the call was not attempted."""
+
+
+class PartialDeliveryError(EgressError):
+    """Part of an export was delivered before a terminal failure; only
+    `undelivered` may be spilled for re-merge — re-sending the whole
+    export would double-count counters at the receiver's Combine."""
+
+    def __init__(self, undelivered, cause: BaseException | None = None):
+        super().__init__(f"partial delivery: {cause}")
+        self.undelivered = undelivered
+
+
+class HTTPStatusError(EgressError):
+    """A transport returned an HTTP error status without raising (fake
+    transports and non-urllib stacks); retryability follows the code."""
+
+    def __init__(self, destination: str, status: int):
+        super().__init__(f"{destination}: HTTP {status}")
+        self.status = status
+
+
+_RETRYABLE_HTTP = (408, 429)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Classify one attempt's failure. Retryable: timeouts, connection
+    errors, HTTP 5xx/408/429, URLErrors (DNS, refused-inside-urllib),
+    and the transient gRPC codes. Terminal: HTTP 4xx (the payload or
+    auth is wrong — retrying re-fails), INVALID_ARGUMENT-class gRPC
+    codes, and anything unrecognized (fail fast, count, spill)."""
+    if isinstance(exc, TransientEgressError):
+        return True
+    if isinstance(exc, TerminalEgressError):
+        return False
+    if isinstance(exc, CircuitOpenError):
+        # an open breaker is a transient condition for OUTER callers
+        # deciding whether to buffer/requeue (Egress.call itself never
+        # classifies it — rejection happens before any attempt)
+        return True
+    if isinstance(exc, HTTPStatusError):
+        return exc.status >= 500 or exc.status in _RETRYABLE_HTTP
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500 or exc.code in _RETRYABLE_HTTP
+    # HTTPError subclasses URLError — this arm must come second
+    if isinstance(exc, urllib.error.URLError):
+        return True
+    if isinstance(exc, (TimeoutError, ConnectionError)):
+        return True
+    try:
+        import grpc
+    except ImportError:         # pragma: no cover - grpc ships in-image
+        grpc = None
+    if grpc is not None and isinstance(exc, grpc.RpcError):
+        code = exc.code() if callable(getattr(exc, "code", None)) else None
+        return code in (grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        grpc.StatusCode.ABORTED,
+                        grpc.StatusCode.UNKNOWN)
+    if isinstance(exc, OSError):
+        return True
+    return False
+
+
+# ------------------------------------------------------------- policies
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 3
+    base_backoff_s: float = 0.2
+    max_backoff_s: float = 5.0
+    # per-call (≈ per-flush, per-destination) wall budget: attempts,
+    # socket timeouts and backoff sleeps all draw from it
+    deadline_s: float = 8.0
+
+
+@dataclass(frozen=True)
+class BreakerPolicy:
+    failure_threshold: int = 5
+    open_duration_s: float = 30.0
+    half_open_successes: int = 1
+
+
+@dataclass(frozen=True)
+class EgressPolicy:
+    retry: RetryPolicy = RetryPolicy()
+    breaker: BreakerPolicy = BreakerPolicy()
+
+
+DEFAULT_POLICY = EgressPolicy()
+
+
+def policy_from_config(cfg) -> EgressPolicy:
+    """Build the shared egress policy from the Config knobs."""
+    from .config import _parse_interval
+    return EgressPolicy(
+        retry=RetryPolicy(
+            max_attempts=max(1, cfg.retry_max_attempts),
+            base_backoff_s=_parse_interval(cfg.retry_backoff_base),
+            max_backoff_s=_parse_interval(cfg.retry_backoff_cap),
+            deadline_s=_parse_interval(cfg.retry_deadline)),
+        breaker=BreakerPolicy(
+            failure_threshold=max(1, cfg.breaker_failure_threshold),
+            open_duration_s=_parse_interval(cfg.breaker_open_duration),
+            half_open_successes=max(1, cfg.breaker_half_open_successes)))
+
+
+# ------------------------------------------------------------- registry
+
+class ResilienceRegistry:
+    """Per-destination counters, drained once per flush by the server
+    into veneur.resilience.*_total self-metrics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, str], int] = {}
+
+    def incr(self, destination: str, counter: str, n: int = 1):
+        if n == 0:
+            return
+        with self._lock:
+            key = (destination, counter)
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def take(self) -> dict[tuple[str, str], int]:
+        """Drain: return-and-reset (interval-delta semantics, like the
+        server's other self-telemetry counters)."""
+        with self._lock:
+            out, self._counters = self._counters, {}
+        return out
+
+    def peek(self, destination: str, counter: str) -> int:
+        with self._lock:
+            return self._counters.get((destination, counter), 0)
+
+
+# The process-default registry: egress objects constructed without an
+# explicit registry (config-built sinks, forwarders) count here, and
+# Server._self_metrics drains it.
+DEFAULT_REGISTRY = ResilienceRegistry()
+
+
+# -------------------------------------------------------------- breaker
+
+_CLOSED, _OPEN, _HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    """Per-destination breaker (state diagram in the module docstring).
+    Thread-safe: sinks flush on their own threads and the proxy fans
+    out concurrently."""
+
+    def __init__(self, destination: str = "", policy: BreakerPolicy
+                 | None = None, clock=time.monotonic,
+                 registry: ResilienceRegistry | None = None):
+        self.destination = destination
+        self.policy = policy or BreakerPolicy()
+        self._clock = clock
+        self._registry = registry or DEFAULT_REGISTRY
+        self._lock = threading.Lock()
+        self._state = _CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._half_open_successes = 0
+        self._probe_inflight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed now? Open→half-open transition happens
+        here (lazily, on the first allow() after the cooldown)."""
+        with self._lock:
+            if self._state == _CLOSED:
+                return True
+            if self._state == _OPEN:
+                if (self._clock() - self._opened_at
+                        >= self.policy.open_duration_s):
+                    self._state = _HALF_OPEN
+                    self._half_open_successes = 0
+                    self._probe_inflight = False
+                else:
+                    return False
+            # half-open: admit one probe at a time
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def record_success(self):
+        with self._lock:
+            if self._state == _HALF_OPEN:
+                self._probe_inflight = False
+                self._half_open_successes += 1
+                if (self._half_open_successes
+                        >= self.policy.half_open_successes):
+                    self._state = _CLOSED
+            self._consecutive_failures = 0
+
+    def record_failure(self):
+        with self._lock:
+            if self._state == _HALF_OPEN:
+                self._probe_inflight = False
+                self._trip_locked()
+                return
+            self._consecutive_failures += 1
+            if (self._state == _CLOSED and self._consecutive_failures
+                    >= self.policy.failure_threshold):
+                self._trip_locked()
+
+    def _trip_locked(self):
+        self._state = _OPEN
+        self._opened_at = self._clock()
+        self._consecutive_failures = 0
+        self._registry.incr(self.destination, "breaker_opened")
+
+
+# --------------------------------------------------------------- egress
+
+def _default_transport(req, timeout=None):
+    """The layer's single raw HTTP call. urllib raises HTTPError for
+    4xx/5xx, which is_retryable classifies by code."""
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def grpc_channel(address: str):
+    """The project's single gRPC channel constructor — egress channels
+    are created here so raw grpc.insecure_channel calls elsewhere are
+    vlint-RS01 strays."""
+    import grpc
+    return grpc.insecure_channel(address)
+
+
+class Egress:
+    """One destination's resilient call wrapper: breaker consult, retry
+    with full-jitter backoff, deadline budget, telemetry. Clock/sleep/
+    rng/transport are injectable for the fault harness."""
+
+    def __init__(self, destination: str,
+                 policy: EgressPolicy | None = None,
+                 breaker: CircuitBreaker | None = None,
+                 transport=None, clock=time.monotonic,
+                 sleep=time.sleep, rng: random.Random | None = None,
+                 registry: ResilienceRegistry | None = None):
+        self.destination = destination
+        self.policy = policy or DEFAULT_POLICY
+        self.registry = registry or DEFAULT_REGISTRY
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+        self._transport = transport or _default_transport
+        self.breaker = breaker or CircuitBreaker(
+            destination, self.policy.breaker, clock=clock,
+            registry=self.registry)
+
+    # -- generic call wrapper --
+
+    def deadline(self) -> float:
+        """An absolute deadline one policy budget from now — pass it to
+        several call()s (e.g. the batches of one flush) so they share
+        ONE budget instead of each getting its own."""
+        return self._clock() + self.policy.retry.deadline_s
+
+    def call(self, fn, *args, timeout_s: float | None = None,
+             deadline: float | None = None, **kwargs):
+        """Run fn(*args, **kwargs) under retry/breaker/deadline. When
+        `timeout_s` is given, each attempt receives a `timeout=` kwarg
+        clamped to min(timeout_s, remaining deadline budget), so socket
+        timeouts can never overrun the flush budget.
+
+        The breaker is consulted ONCE, at call start, and records the
+        call's FINAL outcome: the retry ladder is one logical delivery,
+        so breaker_failure_threshold counts failed deliveries — a
+        threshold <= max_attempts cannot cut retries short or mask the
+        underlying error with CircuitOpenError mid-ladder."""
+        retry = self.policy.retry
+        reg, dest = self.registry, self.destination
+        if not self.breaker.allow():
+            reg.incr(dest, "breaker_rejected")
+            raise CircuitOpenError(
+                f"{dest}: circuit open, call rejected")
+        if deadline is None:
+            deadline = self._clock() + retry.deadline_s
+        attempt = 0
+        while True:
+            attempt += 1
+            reg.incr(dest, "attempts")
+            try:
+                if timeout_s is not None:
+                    remaining = deadline - self._clock()
+                    kwargs["timeout"] = max(
+                        0.001, min(timeout_s, remaining))
+                out = fn(*args, **kwargs)
+            except Exception as e:
+                now = self._clock()
+                if (not is_retryable(e) or attempt >= retry.max_attempts
+                        or now >= deadline):
+                    self.breaker.record_failure()
+                    reg.incr(dest, "failures")
+                    raise
+                delay = self._rng.uniform(0.0, min(
+                    retry.max_backoff_s,
+                    retry.base_backoff_s * (2 ** (attempt - 1))))
+                delay = min(delay, max(0.0, deadline - now))
+                reg.incr(dest, "retries")
+                if delay > 0:
+                    self._sleep(delay)
+                continue
+            self.breaker.record_success()
+            reg.incr(dest, "success")
+            return out
+
+    # -- HTTP helpers --
+
+    def _http(self, req, reader, timeout_s, deadline):
+        def _send(timeout=None):
+            resp = self._transport(req, timeout=timeout)
+            try:
+                status = getattr(resp, "status", None) or 200
+                if status >= 400:
+                    raise HTTPStatusError(self.destination, status)
+                return reader(resp, status)
+            finally:
+                close = getattr(resp, "close", None)
+                if close is not None:
+                    close()
+
+        return self.call(_send, timeout_s=timeout_s, deadline=deadline)
+
+    def post(self, req, timeout_s: float | None = None,
+             deadline: float | None = None) -> int:
+        """Send one urllib-style Request through the transport with the
+        full resilience treatment; returns the final HTTP status. Pass
+        one `deadline` (from .deadline()) across a flush's chunked
+        bodies so they share a single budget."""
+        return self._http(req, lambda resp, status: status, timeout_s,
+                          deadline)
+
+    def fetch(self, req, timeout_s: float | None = None,
+              deadline: float | None = None) -> bytes:
+        """Like post(), but returns the response body (for callers that
+        consume what the destination says, e.g. discovery)."""
+        return self._http(req, lambda resp, status: resp.read(),
+                          timeout_s, deadline)
+
+
+# ---------------------------------------------------------------- spill
+
+class SpillBuffer:
+    """Bounded holding pen for ForwardExport sketches whose delivery
+    failed terminally. Same-key sketches merge on spill (so a long
+    outage stays O(live keys), not O(intervals)); `merge_into` hands
+    everything back to the next interval's export. Not thread-safe by
+    itself — the owning ResilientForwarder serializes access (the
+    server forwards from the single flusher thread)."""
+
+    # one spilled key's concatenated centroid pile is clustered down
+    # when it exceeds this (sum/count stay exact; shape approximate —
+    # the same trade the import path's pre-clustering makes)
+    CENTROID_CAP = 2048
+
+    def __init__(self, max_sketches: int = 65536,
+                 gauge_max_age_intervals: int = 4,
+                 destination: str = "forward",
+                 registry: ResilienceRegistry | None = None):
+        self.max_sketches = max_sketches
+        self.gauge_max_age = gauge_max_age_intervals
+        self.destination = destination
+        self.registry = registry or DEFAULT_REGISTRY
+        # key -> [means, weights, min, max, sum, count, recip]
+        self._histos: dict = {}
+        self._sets: dict = {}      # key -> registers u8[m]
+        self._counters: dict = {}  # key -> float
+        self._gauges: dict = {}    # key -> [value, age_in_failed_flushes]
+        # gauge ages at the last merge_into, so a re-spill of the same
+        # (still-undelivered) gauges continues their age instead of
+        # restarting at 0 — without this, the merge->fail->spill cycle
+        # would keep every stale gauge young forever
+        self._merged_gauge_ages: dict = {}
+
+    def __len__(self):
+        return (len(self._histos) + len(self._sets)
+                + len(self._counters) + len(self._gauges))
+
+    @staticmethod
+    def _cluster(means: np.ndarray, weights: np.ndarray, cap: int):
+        """Weight-preserving cluster-down of a sorted centroid pile to
+        <= cap points (equal-cumulative-weight buckets). Keeps sum and
+        count exact; receivers re-cluster with k1 anyway."""
+        order = np.argsort(means, kind="stable")
+        means, weights = means[order], weights[order]
+        if len(means) <= cap:
+            return means, weights
+        cum = np.cumsum(weights)
+        edges = np.searchsorted(
+            cum, np.linspace(0, cum[-1], cap + 1)[1:-1])
+        edges = np.unique(np.concatenate([[0], edges]))
+        wsum = np.add.reduceat(weights, edges)
+        vsum = np.add.reduceat(means * weights, edges)
+        keep = wsum > 0
+        return (vsum[keep] / wsum[keep]).astype(means.dtype), \
+            wsum[keep].astype(weights.dtype)
+
+    def spill(self, export) -> int:
+        """Absorb one failed interval's export; returns entries spilled.
+        Ages + evicts stale gauges, then enforces the sketch budget."""
+        n = 0
+        for key, means, weights, vmin, vmax, vsum, cnt, recip in (
+                export.histograms):
+            means = np.asarray(means, np.float32)
+            weights = np.asarray(weights, np.float32)
+            live = weights > 0
+            means, weights = means[live], weights[live]
+            cur = self._histos.get(key)
+            if cur is None:
+                self._histos[key] = [means, weights, float(vmin),
+                                     float(vmax), float(vsum),
+                                     float(cnt), float(recip)]
+            else:
+                m = np.concatenate([cur[0], means])
+                w = np.concatenate([cur[1], weights])
+                if len(m) > self.CENTROID_CAP:
+                    m, w = self._cluster(m, w, self.CENTROID_CAP)
+                cur[0], cur[1] = m, w
+                cur[2] = min(cur[2], float(vmin))
+                cur[3] = max(cur[3], float(vmax))
+                cur[4] += float(vsum)
+                cur[5] += float(cnt)
+                cur[6] += float(recip)
+            n += 1
+        for key, regs in export.sets:
+            regs = np.asarray(regs, np.uint8)
+            cur = self._sets.get(key)
+            self._sets[key] = (regs if cur is None
+                               else np.maximum(cur, regs))
+            n += 1
+        for key, value in export.counters:
+            self._counters[key] = self._counters.get(key, 0.0) \
+                + float(value)
+            n += 1
+        # gauges: age everything already pending by one failed
+        # interval, evict over-age. An incoming gauge that was part of
+        # the last merge_into is the SAME still-undelivered value
+        # coming back — it continues its age (+1); a key re-reported
+        # fresh this interval appears again later in the list (merge
+        # prepends stale) and resets to 0 via the consumed-age pop.
+        merged_ages, self._merged_gauge_ages = \
+            self._merged_gauge_ages, {}
+        evicted = 0
+        for key in list(self._gauges):
+            self._gauges[key][1] += 1
+            if self._gauges[key][1] > self.gauge_max_age:
+                del self._gauges[key]
+                evicted += 1
+        for key, value in export.gauges:
+            age = merged_ages.pop(key, -1) + 1
+            if age > self.gauge_max_age:
+                evicted += 1
+                continue
+            self._gauges[key] = [float(value), age]
+            n += 1
+        evicted += self._enforce_budget()
+        self.registry.incr(self.destination, "spilled", n)
+        self.registry.incr(self.destination, "spill_evicted", evicted)
+        return n
+
+    def _enforce_budget(self) -> int:
+        evicted = 0
+        # oldest-inserted first, heaviest type first (dict order is
+        # insertion order); counters/gauges are scalars and go last
+        for d in (self._histos, self._sets, self._counters,
+                  self._gauges):
+            while len(self) > self.max_sketches and d:
+                d.pop(next(iter(d)))
+                evicted += 1
+        return evicted
+
+    def merge_into(self, export):
+        """Merge everything pending into `export` (in place) and clear.
+        Spilled gauges PREPEND so the current interval's fresher value
+        wins last-write-wins at the receiver; sketch types append —
+        the receiver's Combine path merges same-key entries anyway.
+        Gauge ages are remembered so that if THIS export fails too, the
+        re-spill continues them (reset unconditionally: a successful
+        delivery must not leak ages onto later fresh values)."""
+        self._merged_gauge_ages = {key: age for key, (_v, age)
+                                   in self._gauges.items()}
+        if not len(self):
+            return export
+        n = len(self)
+        export.histograms.extend(
+            (key, h[0], h[1], h[2], h[3], h[4], h[5], h[6])
+            for key, h in self._histos.items())
+        export.sets.extend(self._sets.items())
+        export.counters.extend(self._counters.items())
+        export.gauges[:0] = [(key, v) for key, (v, _a)
+                             in self._gauges.items()]
+        self._histos, self._sets = {}, {}
+        self._counters, self._gauges = {}, {}
+        self.registry.incr(self.destination, "remerged", n)
+        return export
+
+
+class ResilientForwarder:
+    """Wraps the server's forwarder callable with the spill/re-merge
+    contract: pending sketches from failed intervals are merged into
+    each outgoing export; a failing send (terminal — the inner
+    forwarder owns its own retry/breaker) spills the merged export
+    back. Called only from the flusher thread, like the forwarder it
+    wraps."""
+
+    def __init__(self, inner, destination: str = "forward",
+                 max_spill_sketches: int = 65536,
+                 gauge_max_age_intervals: int = 4,
+                 registry: ResilienceRegistry | None = None):
+        self.inner = inner
+        self.destination = destination
+        self.registry = registry or DEFAULT_REGISTRY
+        self.spill = SpillBuffer(
+            max_sketches=max_spill_sketches,
+            gauge_max_age_intervals=gauge_max_age_intervals,
+            destination=destination, registry=self.registry)
+
+    @property
+    def pending_spill(self) -> int:
+        """Sketches awaiting re-merge; the server forwards even an
+        otherwise-empty interval while this is nonzero, so spilled data
+        cannot strand when traffic stops."""
+        return len(self.spill)
+
+    def __call__(self, export):
+        export = self.spill.merge_into(export)
+        try:
+            self.inner(export)
+        except PartialDeliveryError as e:
+            # some batches landed: spill only what didn't
+            n = self.spill.spill(e.undelivered)
+            log.warning(
+                "forward to %s partially failed; %d undelivered "
+                "sketches spilled for re-merge into the next interval",
+                self.destination, n)
+            raise
+        except Exception:
+            n = self.spill.spill(export)
+            log.warning(
+                "forward to %s failed; %d sketches spilled for "
+                "re-merge into the next interval", self.destination, n)
+            raise
+
+    def close(self):
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()

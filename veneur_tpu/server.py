@@ -28,6 +28,7 @@ import socket
 import threading
 import time
 
+from . import resilience
 from . import trace as trace_mod
 from .config import Config, _parse_interval
 from .ingest import parser
@@ -92,6 +93,10 @@ class Server:
         self.native_pump = None
         if cfg.native_ingest:
             self._setup_native_ingest()
+        # one shared egress policy (retry/breaker knobs) for every
+        # config-built sink and forwarder; per-destination breakers are
+        # created inside each Egress
+        self._egress_policy = resilience.policy_from_config(cfg)
         self.sinks = sinks if sinks is not None else self._sinks_from_config()
         if plugins is not None:
             self.plugins = plugins
@@ -106,14 +111,21 @@ class Server:
                     bucket=cfg.aws_s3_bucket, region=cfg.aws_region,
                     access_key=cfg.aws_access_key_id,
                     secret_key=cfg.aws_secret_access_key,
-                    interval_s=max(1, round(cfg.interval_seconds))))
+                    interval_s=max(1, round(cfg.interval_seconds)),
+                    egress_policy=self._egress_policy))
         if forwarder is None and cfg.forward_address:
             if cfg.forward_use_grpc:
                 from .cluster.forward import GrpcForwarder
-                forwarder = GrpcForwarder(cfg.forward_address)
+                forwarder = GrpcForwarder(
+                    cfg.forward_address,
+                    timeout_s=cfg.flush_timeout_seconds,
+                    egress_policy=self._egress_policy)
             else:
                 from .cluster.forward import HttpJsonForwarder
-                forwarder = HttpJsonForwarder(cfg.forward_address)
+                forwarder = HttpJsonForwarder(
+                    cfg.forward_address,
+                    timeout_s=cfg.flush_timeout_seconds,
+                    egress_policy=self._egress_policy)
         elif forwarder is None and cfg.consul_forward_service_name:
             # discover the global tier via Consul and re-resolve on the
             # refresh interval (consul.go; Server.RefreshDestinations)
@@ -124,7 +136,22 @@ class Server:
                 cfg.consul_forward_service_name,
                 refresh_interval_s=_parse_interval(
                     cfg.consul_refresh_interval),
-                use_grpc=cfg.forward_use_grpc)
+                use_grpc=cfg.forward_use_grpc,
+                timeout_s=cfg.flush_timeout_seconds,
+                egress_policy=self._egress_policy)
+        if forwarder is not None and not isinstance(
+                forwarder, resilience.ResilientForwarder):
+            # lossless-forward contract: terminal failures spill the
+            # interval's sketches for re-merge into the next flush
+            # instead of dropping them (resilience.SpillBuffer)
+            forwarder = resilience.ResilientForwarder(
+                forwarder,
+                destination=(cfg.forward_address
+                             or cfg.consul_forward_service_name
+                             or "forward"),
+                max_spill_sketches=cfg.spill_max_sketches,
+                gauge_max_age_intervals=(
+                    cfg.spill_gauge_max_age_intervals))
         self.forwarder = forwarder   # callable(ForwardExport) or None
         self._grpc_servers = []
         # tags_exclude strips tag names BEFORE key construction (metrics
@@ -180,6 +207,7 @@ class Server:
         self.spans_received = 0
         self.ssf_errors = 0
         self.flush_errors = 0
+        self._last_forward_err = None   # sentry dedupe, under _stats_lock
         self._stats_lock = threading.Lock()
         # SSF span pipeline (SpanWorker + SpanSinks)
         self.span_queue: queue.Queue = queue.Queue(
@@ -266,6 +294,11 @@ class Server:
     def _sinks_from_config(self) -> list[MetricSink]:
         out: list[MetricSink] = []
         cfg = self.cfg
+        # every network sink gets the configured per-attempt timeout
+        # (flush_timeout) and the shared retry/breaker policy — the
+        # CF01-class bug was each constructor keeping its hardcoded 10s
+        pol = self._egress_policy
+        to = cfg.flush_timeout_seconds
         if cfg.datadog_api_key:
             from .sinks.datadog import DatadogMetricSink
             out.append(DatadogMetricSink(
@@ -274,26 +307,30 @@ class Server:
                 hostname=self.hostname,
                 tags=list(cfg.tags),
                 interval_s=max(1, round(cfg.interval_seconds)),
-                flush_max_per_body=cfg.datadog_flush_max_per_body))
+                flush_max_per_body=cfg.datadog_flush_max_per_body,
+                timeout_s=to, egress_policy=pol))
         if cfg.signalfx_api_key:
             from .sinks.signalfx import SignalFxMetricSink
             out.append(SignalFxMetricSink(
                 api_key=cfg.signalfx_api_key,
                 endpoint=cfg.signalfx_endpoint_base,
                 hostname=self.hostname, tags=list(cfg.tags),
-                vary_key_by=cfg.signalfx_vary_key_by))
+                vary_key_by=cfg.signalfx_vary_key_by,
+                timeout_s=to, egress_policy=pol))
         if cfg.kafka_broker and (cfg.kafka_metric_topic or cfg.kafka_topic):
             from .sinks.kafka import KafkaMetricSink
             out.append(KafkaMetricSink(
                 broker=cfg.kafka_broker,
-                metric_topic=cfg.kafka_metric_topic or cfg.kafka_topic))
+                metric_topic=cfg.kafka_metric_topic or cfg.kafka_topic,
+                egress_policy=pol))
         if cfg.newrelic_insert_key:
             from .sinks.newrelic import NewRelicMetricSink
             out.append(NewRelicMetricSink(
                 insert_key=cfg.newrelic_insert_key,
                 account_id=cfg.newrelic_account_id,
                 tags=list(cfg.tags),
-                interval_s=cfg.interval_seconds))
+                interval_s=cfg.interval_seconds,
+                timeout_s=to, egress_policy=pol))
         if cfg.prometheus_repeater_address:
             from .sinks.prometheus import PrometheusMetricSink
             out.append(PrometheusMetricSink(
@@ -309,6 +346,8 @@ class Server:
         samples reach the metric pipeline (sinks/ssfmetrics)."""
         from .sinks.ssfmetrics import SSFMetricsSink
 
+        pol = self._egress_policy
+        to = self.cfg.flush_timeout_seconds
         out = [SSFMetricsSink(
             self._route_metric,
             indicator_span_timer_name=self.cfg.indicator_span_timer_name)]
@@ -316,30 +355,35 @@ class Server:
             from .sinks.datadog import DatadogSpanSink
             out.append(DatadogSpanSink(
                 trace_api_address=self.cfg.datadog_trace_api_address,
-                buffer_size=self.cfg.ssf_buffer_size))
+                buffer_size=self.cfg.ssf_buffer_size,
+                timeout_s=to, egress_policy=pol))
         if self.cfg.splunk_hec_address:
             from .sinks.splunk import SplunkSpanSink
             out.append(SplunkSpanSink(
                 hec_address=self.cfg.splunk_hec_address,
                 token=self.cfg.splunk_hec_token,
-                hostname=self.hostname))
+                hostname=self.hostname,
+                timeout_s=to, egress_policy=pol))
         if self.cfg.xray_address:
             from .sinks.xray import XRaySpanSink
             out.append(XRaySpanSink(daemon_address=self.cfg.xray_address))
         if self.cfg.falconer_address:
             from .sinks.grpsink import GrpcSpanSink
-            out.append(GrpcSpanSink(self.cfg.falconer_address))
+            out.append(GrpcSpanSink(self.cfg.falconer_address,
+                                    timeout_s=to, egress_policy=pol))
         if self.cfg.kafka_broker and self.cfg.kafka_span_topic:
             from .sinks.kafka import KafkaSpanSink
             out.append(KafkaSpanSink(
                 broker=self.cfg.kafka_broker,
-                span_topic=self.cfg.kafka_span_topic))
+                span_topic=self.cfg.kafka_span_topic,
+                egress_policy=pol))
         if self.cfg.lightstep_access_token:
             from .sinks.lightstep import LightStepSpanSink
             out.append(LightStepSpanSink(
                 access_token=self.cfg.lightstep_access_token,
                 collector_url=self.cfg.lightstep_collector_host,
-                hostname=self.hostname))
+                hostname=self.hostname,
+                timeout_s=to, egress_policy=pol))
         if self.cfg.debug:
             from .sinks.basic import BlackholeSpanSink
             out.append(BlackholeSpanSink())
@@ -921,13 +965,16 @@ class Server:
             finally:
                 q.task_done()
 
-    def drain(self, timeout: float = 10.0) -> bool:
+    def drain(self, timeout: float = 10.0, *, clock=time.monotonic,
+              sleep=time.sleep) -> bool:
         """Block until every enqueued span and metric has been fully
         processed by its worker (not merely popped). Deterministic
         replacement for sleep-based settling in tests: uses the queues'
         unfinished-task accounting, so an item mid-`eng.process` still
-        counts as in flight."""
-        deadline = time.monotonic() + timeout
+        counts as in flight. `clock`/`sleep` are injectable (the fault
+        harness's FakeClock) so the deadline-expiry path is testable
+        without real waiting."""
+        deadline = clock() + timeout
         if self.native_pump is not None:
             # bridge rings + slow path first; slow-path items land on the
             # worker queues, which the loop below then settles
@@ -937,9 +984,9 @@ class Server:
         while True:
             if all(q.unfinished_tasks == 0 for q in queues):
                 return True
-            if time.monotonic() >= deadline:
+            if clock() >= deadline:
                 return False
-            time.sleep(0.005)
+            sleep(0.005)
 
     # ------------- flush -------------
 
@@ -1021,17 +1068,31 @@ class Server:
             status_metrics + self._self_metrics(ts, t0, eng_stats))
         self._fan_out(frameset, events, checks)
 
+        # forward when the interval produced exports OR earlier spilled
+        # sketches await re-merge — an idle interval must still retry a
+        # recovered endpoint, or spilled data strands in the buffer
         if self.forwarder is not None and (
                 merged_export.histograms or merged_export.sets
-                or merged_export.counters or merged_export.gauges):
+                or merged_export.counters or merged_export.gauges
+                or getattr(self.forwarder, "pending_spill", 0)):
             try:
                 with trace_mod.start_span(self.trace_client,
                                           "veneur.flush.forward",
                                           service="veneur"):
                     self.forwarder(merged_export)
+                with self._stats_lock:
+                    self._last_forward_err = None
             except Exception as e:
                 log.exception("forward failed")
-                if self._sentry is not None:
+                # a sustained outage (breaker open / no destinations)
+                # fails every tick with the same error; capture each
+                # DISTINCT failure once, not one event per interval —
+                # the resilience counters carry the per-tick signal
+                sig = f"{type(e).__name__}: {e}"
+                with self._stats_lock:
+                    repeat = sig == self._last_forward_err
+                    self._last_forward_err = sig
+                if self._sentry is not None and not repeat:
                     self._sentry.capture(e, "forward failed")
         with self._stats_lock:
             self.flush_count += 1
@@ -1121,6 +1182,27 @@ class Server:
             # sink doesn't masquerade as that sink in the skip counter
             out.append(mk("veneur.sink.flush_skipped_total", skips,
                           MetricType.COUNTER, [f"{kind}:{name}"]))
+        # ---- drop taxonomy ----
+        # Losses are counted exactly once, at the layer that owns them:
+        #   veneur.worker.dropped_total          ingest backpressure —
+        #     full worker queues / native rings (queue_drops). Data is
+        #     GONE; it never reached a bank.
+        #   veneur.samples.dropped_no_slot_total bank capacity — key
+        #     churn beyond the slot budget. Also gone.
+        #   veneur.sink.flush_errors_total       a sink's delivery
+        #     failed AFTER the resilience layer's retries; that sink's
+        #     copy of the interval is gone (other sinks unaffected).
+        #   veneur.resilience.*                  the egress layer's own
+        #     accounting (per destination:) — attempts/retries/
+        #     failures/breaker_* describe delivery effort;
+        #     spilled/remerged_total are NOT drops OR deliveries: a
+        #     failed forward's sketches are spilled, then re-merged
+        #     into the next interval's forward (lossless), and only
+        #     spill_evicted_total (budget/gauge-age eviction) is loss.
+        for (dest, cname), v in sorted(
+                resilience.DEFAULT_REGISTRY.take().items()):
+            out.append(mk(f"veneur.resilience.{cname}_total", v,
+                          MetricType.COUNTER, [f"destination:{dest}"]))
         if self._stats_sock is not None:
             # scopedstatsd mode: ship veneur.* over the wire to
             # stats_address (usually this server's own statsd port)

@@ -25,6 +25,7 @@ import urllib.request
 import zlib
 
 from ..metrics import InterMetric, MetricType
+from ..resilience import Egress, EgressPolicy, is_retryable
 from . import MetricSink, SpanSink
 
 log = logging.getLogger("veneur_tpu.sinks.datadog")
@@ -34,7 +35,8 @@ class DatadogMetricSink(MetricSink):
     def __init__(self, api_key: str, api_url: str = "https://app.datadoghq.com",
                  hostname: str = "", tags: list[str] | None = None,
                  interval_s: int = 10, flush_max_per_body: int = 25_000,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, egress: Egress | None = None,
+                 egress_policy: EgressPolicy | None = None):
         self.api_key = api_key
         self.api_url = api_url.rstrip("/")
         self.hostname = hostname
@@ -42,6 +44,7 @@ class DatadogMetricSink(MetricSink):
         self.interval_s = interval_s
         self.flush_max_per_body = flush_max_per_body
         self.timeout_s = timeout_s
+        self._egress = egress or Egress("datadog", policy=egress_policy)
         self._tag_memo: dict = {}
 
     def name(self) -> str:
@@ -65,7 +68,7 @@ class DatadogMetricSink(MetricSink):
             s["device_name"] = device
         return s
 
-    def _post(self, path: str, body: dict):
+    def _post(self, path: str, body: dict, deadline=None):
         data = zlib.compress(json.dumps(body).encode())
         req = urllib.request.Request(
             f"{self.api_url}{path}?api_key={self.api_key}",
@@ -73,10 +76,8 @@ class DatadogMetricSink(MetricSink):
             headers={"Content-Type": "application/json",
                      "Content-Encoding": "deflate"},
             method="POST")
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            if resp.status >= 400:
-                raise RuntimeError(
-                    f"datadog POST {path}: HTTP {resp.status}")
+        self._egress.post(req, timeout_s=self.timeout_s,
+                          deadline=deadline)
 
     def flush(self, metrics):
         series, checks = [], []
@@ -85,13 +86,16 @@ class DatadogMetricSink(MetricSink):
                 checks.append(m)
             else:
                 series.append(self._series(m))
-        self._post_series(series)
-        self._post_status(checks)
+        # chunked bodies + checks share ONE flush deadline budget
+        deadline = self._egress.deadline()
+        self._post_series(series, deadline)
+        self._post_status(checks, deadline)
 
-    def _post_series(self, series):
+    def _post_series(self, series, deadline=None):
         for i in range(0, len(series), self.flush_max_per_body):
             self._post("/api/v1/series",
-                       {"series": series[i:i + self.flush_max_per_body]})
+                       {"series": series[i:i + self.flush_max_per_body]},
+                       deadline=deadline)
 
     def _split_tags(self, tg: list) -> tuple:
         """(host_override, device, merged_tags) for one key's shared tag
@@ -158,11 +162,12 @@ class DatadogMetricSink(MetricSink):
                     checks.append(x)
                 else:
                     app(self._series(x))
-        self._post_series(series)
-        self._post_status(checks)
+        deadline = self._egress.deadline()
+        self._post_series(series, deadline)
+        self._post_status(checks, deadline)
         return len(series) + len(checks)
 
-    def _post_status(self, status_metrics):
+    def _post_status(self, status_metrics, deadline=None):
         """Status-typed InterMetrics (the StatusCheck sampler's flush
         shape) become Datadog service checks — the reference's datadog
         sink does the same conversion at flush."""
@@ -174,7 +179,7 @@ class DatadogMetricSink(MetricSink):
             if m.hostname:
                 body["host_name"] = m.hostname
             try:
-                self._post("/api/v1/check_run", body)
+                self._post("/api/v1/check_run", body, deadline=deadline)
             except Exception as ex:
                 log.warning("datadog check post failed: %s", ex)
 
@@ -217,14 +222,19 @@ class DatadogSpanSink(SpanSink):
     straight onto the agent's start/duration fields."""
 
     def __init__(self, trace_api_address: str = "http://127.0.0.1:8126",
-                 buffer_size: int = 16384, timeout_s: float = 10.0):
+                 buffer_size: int = 16384, timeout_s: float = 10.0,
+                 egress: Egress | None = None,
+                 egress_policy: EgressPolicy | None = None):
         self.trace_api_address = trace_api_address.rstrip("/")
         self.buffer_size = buffer_size
         self.timeout_s = timeout_s
+        self._egress = egress or Egress("datadog-traces",
+                                        policy=egress_policy)
         self._spans: list = []
         self._lock = threading.Lock()
         self.dropped_total = 0
         self.flushed_total = 0
+        self.requeued_total = 0
 
     def name(self) -> str:
         return "datadog"
@@ -270,12 +280,29 @@ class DatadogSpanSink(SpanSink):
             f"{self.trace_api_address}/v0.3/traces", data=body,
             headers={"Content-Type": "application/json"}, method="PUT")
         try:
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout_s) as resp:
-                if resp.status >= 400:
-                    raise RuntimeError(f"HTTP {resp.status}")
+            self._egress.post(req, timeout_s=self.timeout_s)
             self.flushed_total += len(spans)
         except Exception as e:
-            self.dropped_total += len(spans)
-            log.warning("datadog trace flush failed "
-                        "(%d spans dropped): %s", len(spans), e)
+            if not is_retryable(e):
+                # terminal (4xx: the batch itself is refused) —
+                # requeueing would re-PUT the same doomed body forever,
+                # pinning the ring and starving new spans
+                with self._lock:
+                    self.dropped_total += len(spans)
+                log.warning("datadog trace flush terminally failed "
+                            "(%d spans dropped): %s", len(spans), e)
+                return
+            # transient: requeue the failed spans into the ring up to
+            # buffer_size (next flush retries them); only what the ring
+            # cannot hold is dropped — ring semantics, OLDEST overflow
+            # goes first
+            with self._lock:
+                room = max(0, self.buffer_size - len(self._spans))
+                keep = spans[-room:] if room else []
+                self._spans[:0] = keep
+                self.requeued_total += len(keep)
+                evicted = len(spans) - len(keep)
+                self.dropped_total += evicted
+            log.warning(
+                "datadog trace flush failed (%d spans requeued, %d "
+                "evicted): %s", len(keep), evicted, e)

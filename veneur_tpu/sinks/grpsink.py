@@ -17,6 +17,7 @@ from concurrent import futures
 import grpc
 
 from . import SpanSink
+from ..resilience import Egress, grpc_channel
 from ..ssf.protos import ssf_pb2
 
 log = logging.getLogger("veneur_tpu.sinks.grpsink")
@@ -27,15 +28,21 @@ SEND_SPAN = "/ssfspans.SpanSink/SendSpan"
 class GrpcSpanSink(SpanSink):
     """Sends happen on a private sender thread behind a bounded queue so
     a slow/hung endpoint stalls only this sink, never the span worker
-    (the sink-independence contract of sinks/__init__.py)."""
+    (the sink-independence contract of sinks/__init__.py). Each send
+    rides the resilience layer: retried on transient gRPC codes, and a
+    dead endpoint trips the breaker so the sender drains the queue with
+    fast rejections instead of a timeout per span."""
 
     def __init__(self, address: str, timeout_s: float = 5.0,
-                 capacity: int = 8192):
+                 capacity: int = 8192, egress: Egress | None = None,
+                 egress_policy=None):
         import queue
         import threading
 
         self.address = address
         self.timeout_s = timeout_s
+        self._egress = egress or Egress(f"grpc://{address}",
+                                        policy=egress_policy)
         self._channel = None
         self._send = None
         self.sent_total = 0
@@ -50,7 +57,7 @@ class GrpcSpanSink(SpanSink):
         return "grpsink"
 
     def start(self) -> None:
-        self._channel = grpc.insecure_channel(self.address)
+        self._channel = grpc_channel(self.address)
         self._send = self._channel.unary_unary(
             SEND_SPAN,
             request_serializer=ssf_pb2.SSFSpan.SerializeToString,
@@ -77,7 +84,8 @@ class GrpcSpanSink(SpanSink):
             if span is None:
                 return
             try:
-                self._send(span, timeout=self.timeout_s)
+                self._egress.call(self._send, span,
+                                  timeout_s=self.timeout_s)
                 self.sent_total += 1
             except Exception as e:
                 # never let the sender thread die — a dead thread would

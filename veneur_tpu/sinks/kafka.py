@@ -38,6 +38,17 @@ def metric_to_payload(m: InterMetric) -> dict:
     }
 
 
+def _produce_batch(egress, producer, msgs):
+    """Whole-batch produce under the egress treatment (shared by both
+    kafka sinks): the batch retries as a unit, so re-produced messages
+    are at-least-once — the reference's sarama producer semantics."""
+    def _all():
+        for topic, key, value in msgs:
+            producer(topic, key, value)
+
+    egress.call(_all)
+
+
 def _default_producer(broker: str):
     """Build a producer from kafka-python if present, else None."""
     try:
@@ -53,10 +64,14 @@ def _default_producer(broker: str):
 
 
 class KafkaMetricSink(MetricSink):
-    def __init__(self, broker: str, metric_topic: str, producer=None):
+    def __init__(self, broker: str, metric_topic: str, producer=None,
+                 egress=None, egress_policy=None):
+        from ..resilience import Egress
         self.broker = broker
         self.metric_topic = metric_topic
         self.producer = producer
+        self._egress = egress or Egress(f"kafka://{broker}",
+                                        policy=egress_policy)
         self.dropped_total = 0
         self._lock = threading.Lock()
 
@@ -76,6 +91,7 @@ class KafkaMetricSink(MetricSink):
             with self._lock:
                 self.dropped_total += len(metrics)
             return
+        msgs = []
         for m in metrics:
             if m.type == MetricType.STATUS:
                 continue  # service checks are Datadog-shaped; skip
@@ -83,12 +99,22 @@ class KafkaMetricSink(MetricSink):
             # per-series ordering survives (the reference's partition key)
             key = f"{m.name}|{','.join(m.tags)}".encode()
             value = json.dumps(metric_to_payload(m)).encode()
-            self.producer(self.metric_topic, key, value)
+            msgs.append((self.metric_topic, key, value))
+        if not msgs:
+            return
+        try:
+            _produce_batch(self._egress, self.producer, msgs)
+        except Exception:
+            with self._lock:
+                self.dropped_total += len(msgs)
+            raise
 
 
 class KafkaSpanSink(SpanSink):
     def __init__(self, broker: str, span_topic: str, producer=None,
-                 encoding: str = "protobuf", max_buffer: int = 16384):
+                 encoding: str = "protobuf", max_buffer: int = 16384,
+                 egress=None, egress_policy=None):
+        from ..resilience import Egress
         if encoding not in ("protobuf", "json"):
             raise ValueError(f"bad kafka span encoding {encoding!r}")
         self.broker = broker
@@ -96,6 +122,8 @@ class KafkaSpanSink(SpanSink):
         self.producer = producer
         self.encoding = encoding
         self.max_buffer = max_buffer
+        self._egress = egress or Egress(f"kafka://{broker}",
+                                        policy=egress_policy)
         self._buf: list = []
         self._lock = threading.Lock()
         self.dropped_total = 0
@@ -137,6 +165,14 @@ class KafkaSpanSink(SpanSink):
             with self._lock:
                 self.dropped_total += len(spans)
             return
-        for s in spans:
-            self.producer(self.span_topic,
-                          str(s.trace_id).encode(), self._encode(s))
+        if not spans:
+            return
+        msgs = [(self.span_topic, str(s.trace_id).encode(),
+                 self._encode(s)) for s in spans]
+        try:
+            _produce_batch(self._egress, self.producer, msgs)
+        except Exception as e:
+            with self._lock:
+                self.dropped_total += len(msgs)
+            log.warning("kafka span flush failed (%d dropped): %s",
+                        len(msgs), e)

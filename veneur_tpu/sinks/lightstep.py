@@ -43,7 +43,9 @@ def span_to_record(span) -> dict:
 class LightStepSpanSink(SpanSink):
     def __init__(self, access_token: str, collector_url: str,
                  hostname: str = "", max_buffer: int = 16384,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, egress=None,
+                 egress_policy=None):
+        from ..resilience import Egress
         # no default collector here: config.lightstep_collector_host is
         # the single source of truth for the endpoint
         self.access_token = access_token
@@ -51,6 +53,8 @@ class LightStepSpanSink(SpanSink):
         self.hostname = hostname
         self.max_buffer = max_buffer
         self.timeout_s = timeout_s
+        self._egress = egress or Egress("lightstep",
+                                        policy=egress_policy)
         self._buf: list = []
         self._lock = threading.Lock()
         self.flushed_total = 0
@@ -80,8 +84,7 @@ class LightStepSpanSink(SpanSink):
             self.url, data=body, method="POST",
             headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s):
-                pass
+            self._egress.post(req, timeout_s=self.timeout_s)
             self.flushed_total += len(spans)
         except Exception as e:
             self.dropped_total += len(spans)

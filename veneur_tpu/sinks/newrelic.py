@@ -39,7 +39,9 @@ class NewRelicMetricSink(MetricSink):
                  metric_url: str = "https://metric-api.newrelic.com",
                  event_url: str = "https://insights-collector.newrelic.com",
                  tags: list[str] | None = None, interval_s: float = 10.0,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, egress=None,
+                 egress_policy=None):
+        from ..resilience import Egress
         self.insert_key = insert_key
         self.account_id = account_id
         self.metric_url = metric_url.rstrip("/") + "/metric/v1"
@@ -48,6 +50,8 @@ class NewRelicMetricSink(MetricSink):
         self.tags = tags or []
         self.interval_s = interval_s
         self.timeout_s = timeout_s
+        self._egress = egress or Egress("newrelic",
+                                        policy=egress_policy)
         self.flushed_total = 0
 
     def name(self) -> str:
@@ -74,8 +78,8 @@ class NewRelicMetricSink(MetricSink):
             headers={"Content-Type": "application/json",
                      "Api-Key": self.insert_key})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s):
-                return True
+            self._egress.post(req, timeout_s=self.timeout_s)
+            return True
         except Exception as e:
             log.error("newrelic post to %s failed: %s", url, e)
             return False

@@ -55,13 +55,17 @@ def object_key(hostname: str, ts: float | None = None) -> str:
 class S3Plugin(Plugin):
     def __init__(self, bucket: str, region: str = "",
                  access_key: str = "", secret_key: str = "",
-                 interval_s: int = 10, uploader=None):
+                 interval_s: int = 10, uploader=None, egress=None,
+                 egress_policy=None):
+        from ..resilience import Egress
         self.bucket = bucket
         self.region = region
         self.access_key = access_key
         self.secret_key = secret_key
         self.interval_s = interval_s
         self.uploader = uploader
+        self._egress = egress or Egress(f"s3://{bucket}",
+                                        policy=egress_policy)
         self.uploaded_total = 0
         self.dropped_total = 0
         if self.uploader is None:
@@ -88,7 +92,8 @@ class S3Plugin(Plugin):
             for line in lines:
                 gz.write(line.encode())
         try:
-            self.uploader(self.bucket, object_key(hostname), buf.getvalue())
+            self._egress.call(self.uploader, self.bucket,
+                              object_key(hostname), buf.getvalue())
             self.uploaded_total += n
         except Exception as e:
             self.dropped_total += n

@@ -13,6 +13,7 @@ import logging
 import urllib.request
 
 from ..metrics import MetricType
+from ..resilience import Egress, EgressPolicy
 from . import MetricSink
 
 log = logging.getLogger("veneur_tpu.sinks.signalfx")
@@ -23,7 +24,8 @@ class SignalFxMetricSink(MetricSink):
                  endpoint: str = "https://ingest.signalfx.com",
                  hostname: str = "", tags: list[str] | None = None,
                  vary_key_by: str = "", per_tag_keys: dict | None = None,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, egress: Egress | None = None,
+                 egress_policy: EgressPolicy | None = None):
         self.api_key = api_key
         self.endpoint = endpoint.rstrip("/")
         self.hostname = hostname
@@ -31,6 +33,8 @@ class SignalFxMetricSink(MetricSink):
         self.vary_key_by = vary_key_by
         self.per_tag_keys = per_tag_keys or {}
         self.timeout_s = timeout_s
+        self._egress = egress or Egress("signalfx",
+                                        policy=egress_policy)
 
     def name(self) -> str:
         return "signalfx"
@@ -61,6 +65,7 @@ class SignalFxMetricSink(MetricSink):
             kind = ("counter" if m.type == MetricType.COUNTER else "gauge")
             by_token.setdefault(self._token_for(m), {}).setdefault(
                 kind, []).append(dp)
+        deadline = self._egress.deadline()   # one budget, all tokens
         for token, body in by_token.items():
             req = urllib.request.Request(
                 f"{self.endpoint}/v2/datapoint",
@@ -68,7 +73,5 @@ class SignalFxMetricSink(MetricSink):
                 headers={"Content-Type": "application/json",
                          "X-SF-Token": token},
                 method="POST")
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout_s) as resp:
-                if resp.status >= 400:
-                    raise RuntimeError(f"signalfx: HTTP {resp.status}")
+            self._egress.post(req, timeout_s=self.timeout_s,
+                              deadline=deadline)

@@ -44,12 +44,15 @@ def span_to_event(span, hostname: str) -> dict:
 
 class SplunkSpanSink(SpanSink):
     def __init__(self, hec_address: str, token: str, hostname: str = "",
-                 max_buffer: int = 16384, timeout_s: float = 10.0):
+                 max_buffer: int = 16384, timeout_s: float = 10.0,
+                 egress=None, egress_policy=None):
+        from ..resilience import Egress
         self.url = hec_address.rstrip("/") + "/services/collector/event"
         self.token = token
         self.hostname = hostname
         self.max_buffer = max_buffer
         self.timeout_s = timeout_s
+        self._egress = egress or Egress("splunk", policy=egress_policy)
         self._buf: list = []
         self._lock = threading.Lock()
         self.flushed_total = 0
@@ -79,8 +82,7 @@ class SplunkSpanSink(SpanSink):
             headers={"Content-Type": "application/json",
                      "Authorization": f"Splunk {self.token}"})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s):
-                pass
+            self._egress.post(req, timeout_s=self.timeout_s)
             self.flushed_total += len(batch)
         except Exception as e:
             self.dropped_total += len(batch)

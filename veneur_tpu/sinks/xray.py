@@ -45,10 +45,21 @@ def span_to_segment(span) -> dict:
 
 
 class XRaySpanSink(SpanSink):
-    def __init__(self, daemon_address: str = "127.0.0.1:2000"):
+    """Deliberately NOT behind an Egress: ingest() runs once per span
+    on the span-worker hot path, and a UDP sendto to the local daemon
+    is fire-and-forget — a dropped datagram is the protocol's loss
+    model and retrying a connectionless send has nothing to wait for.
+    Failures still surface per destination in veneur.resilience.*
+    (error path only, no per-span locking)."""
+
+    def __init__(self, daemon_address: str = "127.0.0.1:2000",
+                 registry=None):
+        from ..resilience import DEFAULT_REGISTRY
         host, _, port = daemon_address.rpartition(":")
         host = host.strip("[]") or "127.0.0.1"
         self._dest = (host, int(port))
+        self._dest_name = f"xray://{daemon_address}"
+        self._registry = registry or DEFAULT_REGISTRY
         family = socket.AF_INET6 if ":" in host else socket.AF_INET
         self._sock = socket.socket(family, socket.SOCK_DGRAM)
         self.sent_total = 0
@@ -64,6 +75,7 @@ class XRaySpanSink(SpanSink):
             self.sent_total += 1
         except OSError as e:
             self.dropped_total += 1
+            self._registry.incr(self._dest_name, "failures")
             log.debug("xray send failed: %s", e)
 
     def stop(self) -> None:

@@ -71,6 +71,10 @@ class SentryClient:
             headers={"Content-Type": "application/json",
                      "X-Sentry-Auth": self.auth}, method="POST")
         try:
+            # vlint: disable=RS01 reason=crash-path reporter: must fire
+            # even when breakers are open and during the crash-only
+            # exit, so it cannot depend on the resilience layer it
+            # reports on; fire-and-forget with its own short timeout
             with urllib.request.urlopen(req, timeout=self.timeout_s):
                 pass
             self.sent += 1
