@@ -115,6 +115,53 @@ def worker(k: int, budget_s: float, platform: str,
     _log(f"worker: state on device at "
          f"{time.monotonic() - (deadline - budget_s):.1f}s")
 
+    # ---- compress-only A/B microbench (ISSUE 3): the merge-path
+    # compress (sorted-prefix rank-merge, the serving default) vs the
+    # legacy full-row comparator sort, on the same warm worst-case bank.
+    # Emitted machine-readably as compress_merge_path_ms /
+    # compress_row_sort_ms so the artifact pins the speedup; the
+    # full-sort arm stays dispatchable via full_sort=True (or
+    # VENEUR_TPU_TDIGEST_FULL_SORT=1 process-wide) until a TPU-live
+    # capture confirms the win on hardware.
+    compress_ab = {}
+    # Sub-budget, not the raw deadline: each arm pays its own program
+    # compile (~10-20s @100k CPU) plus timed iters (~12-20s each
+    # there), and an unbounded A/B would starve the headline phases
+    # below of their budget (observed: the 100k worker died after the
+    # A/B without ever printing its record). The pair gets a bounded
+    # slice, drops to 1 iteration per arm when tight, and skips an arm
+    # it cannot at least compile+run once.
+    ab_reserve = 80.0 if k >= 50_000 else 30.0   # tail phases' budget
+    ab_deadline = min(deadline - ab_reserve, time.monotonic() + 110.0)
+    for label, flag in (("compress_merge_path_ms", False),
+                        ("compress_row_sort_ms", True)):
+        need = 20.0 if k >= 50_000 else 3.0      # compile + 1 iter floor
+        if time.monotonic() >= ab_deadline - need:
+            _log(f"worker: compress A/B skipped at {label} (sub-budget)")
+            break
+        try:
+            fn = jax.jit(lambda b, f=flag: tdigest._compress_impl(
+                b, COMPRESSION, full_sort=f))
+            jax.block_until_ready(fn(bank))  # compile (bank not donated)
+            arm = []
+            while len(arm) < 3:
+                t0 = time.monotonic()
+                jax.block_until_ready(fn(bank))
+                arm.append((time.monotonic() - t0) * 1000.0)
+                if time.monotonic() >= ab_deadline:
+                    break
+            compress_ab[label] = round(sorted(arm)[len(arm) // 2], 1)
+            _log(f"worker: {label} = {compress_ab[label]:.0f}ms "
+                 f"({len(arm)} iters)")
+        except Exception as exc:
+            _log(f"worker: compress A/B {label} failed: {exc!r}")
+    if len(compress_ab) == 2:
+        compress_ab["compress_speedup"] = round(
+            compress_ab["compress_row_sort_ms"]
+            / max(compress_ab["compress_merge_path_ms"], 1e-3), 2)
+        _log(f"worker: compress merge-path speedup "
+             f"{compress_ab['compress_speedup']}x")
+
     # The benched program is the ENGINE's real fused flush executable
     # (compress + quantiles + aggregates + counter/gauge/set
     # finalization in one XLA call) — not a bench-only kernel.
@@ -152,7 +199,9 @@ def worker(k: int, budget_s: float, platform: str,
     post_fetch_ms, _ = run_prog(bank, fetch=False)
     times = []
     for i in range(MAX_TIMED_ITERS):
-        if times and time.monotonic() >= deadline:
+        # 10s margin: the fetch/transport phases after this loop are
+        # what make the record parseable — never exec-iterate into them
+        if times and time.monotonic() >= deadline - 10.0:
             _log(f"worker: deadline hit after {len(times)} iters")
             break
         exec_ms, _ = run_prog(bank, fetch=False)
@@ -462,6 +511,7 @@ def worker(k: int, budget_s: float, platform: str,
         "compile_s": round(compile_s, 1),
         "prog_fetch_med_ms": round(fetch_med, 1),
         "fetch_mode": best_mode,
+        **compress_ab,
         **chain,
         **e2e,
     }
@@ -541,7 +591,16 @@ def main() -> int:
         relay_dead = True
     # Phase 1: small K — proves the platform works and warms nothing
     # shared (workers are separate processes), cheap on any backend.
-    r_small = _run_worker(10_000, min(remaining() - 60.0, 150.0), platform)
+    # Capped harder than before: the 100k worker now also carries the
+    # compress A/B (two extra program compiles + timed arms), so it
+    # needs ~170s of budget to emit a complete record on the CPU
+    # backend — the 10k probe self-truncates via its deadline guards.
+    # Reserve that slice only when the total budget can actually fund
+    # it; on a short budget the 10k record is the only one achievable
+    # and must not be starved out of existence.
+    reserve = 190.0 if remaining() >= 260.0 else 60.0
+    r_small = _run_worker(10_000, min(remaining() - reserve, 150.0),
+                          platform)
     if r_small is None and platform == "auto":
         # the cpu fallback only makes sense when the failed attempt was
         # on the default (tunneled) platform; re-running an identical
@@ -577,15 +636,23 @@ def main() -> int:
 
     r_big = None
     if remaining() > 60.0:
-        if platform == "auto" and remaining() >= 160.0:
-            r_big = _run_worker(100_000, remaining() - 100.0, platform,
+        if platform == "auto" and remaining() >= 320.0:
+            # enough for a full attempt AND a cpu fallback
+            r_big = _run_worker(100_000, remaining() - 150.0, platform,
                                 mode_for("auto"))
             if r_big is None:
                 r_big = _run_worker(100_000, remaining() - 10.0, "cpu",
                                     mode_for("cpu"))
         else:
+            # one attempt with everything left: splitting a ~200s
+            # remainder produced two half-budgeted workers that BOTH
+            # died before printing (r6 finding); a single funded worker
+            # beats two starved ones
             r_big = _run_worker(100_000, remaining() - 15.0, platform,
                                 mode_for(platform))
+            if r_big is None and platform == "auto":
+                r_big = _run_worker(100_000, remaining() - 10.0, "cpu",
+                                    mode_for("cpu"))
 
     result = r_big or r_small
     if result is None:

@@ -8,8 +8,11 @@
 #
 # Produces in outdir (default .):
 #   PALLAS_VALIDATION.json       Pallas-HLL vs jnp estimator on real TPU
-#   BENCH_r05_tpu_live.json      bench.py JSON (mode table, chain est,
-#                                e2e under the winning fetch mode)
+#   BENCH_r06_tpu_live.json      bench.py JSON (mode table, chain est,
+#                                e2e under the winning fetch mode, and
+#                                the compress merge-path vs full-sort
+#                                A/B pair on real TPU — the capture that
+#                                retires VENEUR_TPU_TDIGEST_FULL_SORT)
 #   BENCH_c8_tpu.json            bench_suite c8 ingest stages with the
 #                                REAL TPU dispatch path (s4/s5 pump
 #                                rates — never captured on TPU; VERDICT
@@ -52,11 +55,11 @@ fi
 #    value carries the defensible number even when the relay poisons the
 #    raw e2e (bench.py headline logic).
 BENCH_BUDGET_S=500 timeout 560 python bench.py \
-    > "$OUT/BENCH_r05_tpu_live.json.tmp" 2> "$OUT/tpu_window_bench_$TS.log"
+    > "$OUT/BENCH_r06_tpu_live.json.tmp" 2> "$OUT/tpu_window_bench_$TS.log"
 rc=$?
-if [ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)"' "$OUT/BENCH_r05_tpu_live.json.tmp"; then
-    mv "$OUT/BENCH_r05_tpu_live.json.tmp" "$OUT/BENCH_r05_tpu_live.json"
-    echo "bench captured: $(cat "$OUT/BENCH_r05_tpu_live.json")"
+if [ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)"' "$OUT/BENCH_r06_tpu_live.json.tmp"; then
+    mv "$OUT/BENCH_r06_tpu_live.json.tmp" "$OUT/BENCH_r06_tpu_live.json"
+    echo "bench captured: $(cat "$OUT/BENCH_r06_tpu_live.json")"
 else
     echo "bench rc=$rc or not platform=tpu; keeping .tmp for forensics"
 fi

@@ -241,6 +241,36 @@ def test_import_oversized_digest_is_bounded_and_accurate():
     assert abs(out["big.lat.99percentile"].value - exact99) < 0.01 * spread
 
 
+def test_import_rechunk_trusted_passes_use_sorted_prefix(monkeypatch):
+    """Oversized-pile re-clustering beyond the first pass re-merges OUR
+    OWN cluster_rows outputs pile-aligned through the sorted_prefix fast
+    arm. Shrink the cap so a moderate digest needs several passes, and
+    assert the landed state stays exact on count and accurate on
+    quantiles (the fast arm is bit-identical to the full sort, so
+    accuracy must not move)."""
+    from veneur_tpu.models import pipeline as pl
+
+    monkeypatch.setattr(pl, "_IMPORT_W_CAP", 1)  # cap floors at 2*C
+    rng = np.random.default_rng(13)
+    n = 2600  # several trusted (pile-aligned) passes at cap=512
+    data = rng.gamma(4.0, 25.0, n).astype(np.float32)
+
+    glob = AggregationEngine(small_config(
+        is_global=True, percentiles=(0.5, 0.99)))
+    key = parser.MetricKey("deep.lat", "timer", "")
+    glob.import_histogram(
+        key, data, np.ones(n, np.float32),
+        float(data.min()), float(data.max()), float(data.sum()),
+        float(n), float((1.0 / data).sum()))
+    out = by_name(glob.flush(timestamp=10).metrics)
+
+    assert out["deep.lat.count"].value == pytest.approx(n)
+    exact50, exact99 = np.quantile(data, [0.5, 0.99])
+    spread = data.max() - data.min()
+    assert abs(out["deep.lat.50percentile"].value - exact50) < 0.015 * spread
+    assert abs(out["deep.lat.99percentile"].value - exact99) < 0.015 * spread
+
+
 def test_single_column_histo_block_names_are_strings():
     """Regression: a histogram block with exactly one column (no
     percentiles, one aggregate) must still emit string metric names."""

@@ -15,12 +15,47 @@ Thresholds are ~2x the measured steady state.
 """
 
 import time
+import warnings
 
 import numpy as np
 import pytest
 
 from veneur_tpu.ingest.parser import MetricKey
 from veneur_tpu.models.pipeline import AggregationEngine, EngineConfig
+
+
+def test_no_unusable_donation_warnings():
+    """Every donated buffer must actually alias an output (ISSUE 3
+    satellite): the flush executable used to donate all four banks while
+    producing only compact [K, ·] outputs, so XLA warned "Some donated
+    buffers were not usable" on every compile — in every bench run and
+    at every serving start. Donation is now scoped to the banks whose
+    leaves all alias outputs; this compiles the full serving path
+    (ingest kernels + hot-slot programs + flush program, at shapes no
+    other test uses, so the compile genuinely happens) and fails on any
+    donation warning."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        # local-only build AND a forwarding build (fwd_out emits the
+        # raw sketch state, which changes which banks fully alias)
+        for fwd in (False, True):
+            eng = AggregationEngine(EngineConfig(
+                histogram_slots=272 + fwd, counter_slots=24,
+                gauge_slots=24, set_slots=12, batch_size=112,
+                buffer_depth=16, percentiles=(0.5, 0.99),
+                aggregates=("min", "max", "count"),
+                forward_enabled=fwd))
+            eng.warmup()
+            s = eng.histo_keys.lookup(MetricKey("don.t", "timer", ""), 0)
+            eng.ingest_histo_batch(
+                np.full(112, s, np.int32),
+                np.linspace(0.0, 1.0, 112, dtype=np.float32),
+                np.ones(112, np.float32), count=112)
+            res = eng.flush(timestamp=1)
+            assert res.frame is not None
+    bad = [str(w.message) for w in caught
+           if "donated buffers were not usable" in str(w.message)]
+    assert bad == [], "\n".join(bad)
 
 
 @pytest.mark.slow
@@ -55,10 +90,13 @@ def test_fused_flush_10k_slots_under_threshold():
 def test_fused_flush_100k_slots_under_threshold():
     """The north-star cardinality on the CPU backend (VERDICT r4 weak-6:
     the 100k regime the benchmarks headline was CI-blind). Loose gate —
-    the structural cost is the single-core [100k, 311] row sort
-    (~7.4s) plus interp/aggregates; BENCH_r04 measured ~18.4s wall on
-    this box. 40s of process CPU time catches a doubling (an extra
-    compress pass, a de-fused dispatch) without flaking on box noise."""
+    the structural cost is the single-core merge-path compress
+    (buffer-only packed radix sort + bitonic rank-merge; BENCH_r06
+    pins 9751ms vs the 19235ms full-row comparator sort it replaced on
+    the worst-case bank) plus interp/aggregates. 40s of process CPU
+    time catches a doubling (an
+    extra compress pass, a de-fused dispatch, a silent fallback to the
+    full-sort arm) without flaking on box noise."""
     K = 100_000
     eng = AggregationEngine(EngineConfig(
         histogram_slots=K, counter_slots=64, gauge_slots=64,

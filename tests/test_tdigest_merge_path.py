@@ -1,0 +1,173 @@
+"""Exact-equivalence gate for the merge-path compress (ISSUE 3).
+
+The sorted-run merge compress must reproduce the legacy full-row
+comparator sort BIT-FOR-BIT — value order is load-bearing for the ±1%
+accuracy contract, so the rewrite is only safe if the outputs are
+indistinguishable, not merely close. Every test here compares the two
+arms (`full_sort=True` vs the merge-path default) through the f32 bit
+patterns (NaN-safe, sign-of-zero-exact), on adversarial banks:
+duplicate values, ±0.0 mixes, empty rows, inf-padded empties, rows
+mid-overflow-loop. Oracle parity for the new path rides in
+tests/test_tdigest.py, whose whole suite runs through the merge arm by
+default.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veneur_tpu.ops import tdigest
+
+
+def bits_eq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    if a.dtype == np.float32:
+        return np.array_equal(a.view(np.uint32), b.view(np.uint32))
+    return np.array_equal(a, b)
+
+
+def assert_banks_identical(old, new):
+    for field in tdigest.TDigestBank._fields:
+        assert bits_eq(getattr(old, field), getattr(new, field)), \
+            f"bank field {field} diverged between sort arms"
+
+
+def compress_both(bank, comp):
+    old = jax.jit(lambda b: tdigest._compress_impl(
+        b, comp, full_sort=True))(bank)
+    new = jax.jit(lambda b: tdigest._compress_impl(
+        b, comp, full_sort=False))(bank)
+    return old, new
+
+
+def adversarial_bank(comp=10.0, buf_size=32, seed=0):
+    rng = np.random.default_rng(seed)
+    bank = tdigest.init(8, compression=comp, buf_size=buf_size)
+    B = buf_size
+    bv = np.zeros((8, B), np.float32)
+    bw = np.zeros((8, B), np.float32)
+    # signed zeros + duplicates + inf, distinct weights so any
+    # tie-order divergence shows up in the outputs
+    bv[0, :6] = [-0.0, 0.0, 5.0, 5.0, -0.0, np.inf]
+    bw[0, :6] = [1, 2, 3, 4, 5, 6]
+    bv[1, :] = rng.normal(0, 1, B)
+    bw[1, :] = 1
+    bv[2, :4] = [7, 7, 7, 7]           # pure duplicates
+    bw[2, :4] = [1, 2, 3, 4]
+    # row 3 stays empty (inf-padded empties path)
+    bv[4, 0] = 3.25                    # singleton
+    bw[4, 0] = 1
+    bv[5, :] = np.repeat(rng.normal(0, 1, 4), B // 4)  # duplicate blocks
+    bw[5, :] = rng.integers(0, 2, B)   # interleaved zero-weight padding
+    bv[6, :] = -np.abs(rng.normal(0, 100, B))
+    bw[6, :] = 1
+    # +inf is in contract (it sorts last, so the cumsum-diff cluster
+    # sums stay finite-or-inf); -inf and NaN are NOT — a leading -inf
+    # turns every later cluster diff into inf-inf=NaN even in the
+    # legacy full-sort path, and NaN ordering is comparator-undefined
+    bv[7, :] = rng.choice(
+        np.array([0.0, -0.0, 1.5, -1.5, np.inf], np.float32), B)
+    bw[7, :] = rng.integers(0, 3, B)
+    return bank._replace(
+        buf_value=jnp.asarray(bv), buf_weight=jnp.asarray(bw),
+        buf_n=jnp.asarray((bw > 0).sum(1).astype(np.int32))), bv, bw
+
+
+def test_compress_arms_bitwise_identical_adversarial():
+    comp = 10.0
+    bank, bv, bw = adversarial_bank(comp)
+    # three rounds: round 0 merges against an all-empty prefix, later
+    # rounds against a warm (cluster-ordered) prefix — the case the
+    # sorted-prefix invariant actually protects
+    for _ in range(3):
+        old, new = compress_both(bank, comp)
+        assert_banks_identical(old, new)
+        bank = old._replace(
+            buf_value=jnp.asarray(bv), buf_weight=jnp.asarray(bw),
+            buf_n=jnp.asarray((bw > 0).sum(1).astype(np.int32)))
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_compress_arms_bitwise_identical_randomized(seed):
+    rng = np.random.default_rng(seed)
+    K, B, comp = 64, 64, 100.0
+    bank = tdigest.init(K, compression=comp, buf_size=B)
+    # quantized values force heavy cross-run duplication; random
+    # weights make tie order observable
+    bv = np.round(rng.gamma(2.0, 20.0, (K, B)) * 4) / 4
+    bw = rng.integers(0, 4, (K, B)).astype(np.float32)
+    for _ in range(3):
+        bank = bank._replace(
+            buf_value=jnp.asarray(bv.astype(np.float32)),
+            buf_weight=jnp.asarray(bw),
+            buf_n=jnp.asarray((bw > 0).sum(1).astype(np.int32)))
+        old, new = compress_both(bank, comp)
+        assert_banks_identical(old, new)
+        bank = new
+        bv = np.round(rng.gamma(2.0, 20.0, (K, B)) * 4) / 4
+        bw = rng.integers(0, 4, (K, B)).astype(np.float32)
+
+
+def test_add_batch_overflow_loop_arms_identical():
+    """Rows mid-overflow-loop: a batch far larger than the buffer runs
+    compress inside the while_loop body — both arms must land the
+    identical bank."""
+    rng = np.random.default_rng(7)
+    n = 3000
+    slots = np.zeros(n, np.int32)
+    vals = np.round(rng.gamma(2.0, 20.0, n) * 2).astype(np.float32) / 2
+    wts = rng.integers(1, 3, n).astype(np.float32)
+    banks = {}
+    for flag in (True, False):
+        bank = tdigest.init(2, compression=50.0, buf_size=64)
+        banks[flag] = tdigest.add_batch(
+            bank, slots, vals, wts, compression=50.0, full_sort=flag)
+    assert_banks_identical(banks[True], banks[False])
+
+
+def test_cluster_rows_sorted_prefix_arm_identical():
+    """cluster_rows' sorted_prefix fast arm (the importsrv re-merge)
+    must match the full sort when the prefix really is ordered."""
+    rng = np.random.default_rng(11)
+    S, C = 16, 128
+    # prefix: a genuine cluster_rows output (cluster-ordered rows)
+    raw_v = rng.gamma(2.0, 20.0, (S, 256)).astype(np.float32)
+    raw_w = np.ones((S, 256), np.float32)
+    pm, pw = tdigest.cluster_rows(raw_v, raw_w, compression=20.0,
+                                  num_centroids=C)
+    tail_v = rng.gamma(2.0, 20.0, (S, C)).astype(np.float32)
+    tail_w = rng.integers(0, 2, (S, C)).astype(np.float32)
+    vals = np.concatenate([np.asarray(pm), tail_v], axis=1)
+    wts = np.concatenate([np.asarray(pw), tail_w], axis=1)
+    full = tdigest.cluster_rows(vals, wts, compression=20.0,
+                                num_centroids=C)
+    fast = tdigest.cluster_rows(vals, wts, compression=20.0,
+                                num_centroids=C, sorted_prefix=C)
+    assert bits_eq(full[0], fast[0])
+    assert bits_eq(full[1], fast[1])
+
+
+def test_compress_output_prefix_is_cluster_ordered():
+    """The invariant the merge path depends on: positive-weight means
+    non-decreasing per row, zero-weight empties as a suffix — enforced
+    exactly (cummax clamp) even against f32 rounding of the cluster
+    division."""
+    rng = np.random.default_rng(5)
+    K, B = 128, 128
+    bank = tdigest.init(K, compression=100.0, buf_size=B)
+    for _ in range(2):
+        bank = bank._replace(
+            buf_value=jnp.asarray(
+                rng.gamma(2.0, 20.0, (K, B)).astype(np.float32)),
+            buf_weight=jnp.ones((K, B), jnp.float32),
+            buf_n=jnp.full((K,), B, jnp.int32))
+        bank = tdigest.compress(bank, compression=100.0)
+    mean = np.asarray(bank.mean)
+    weight = np.asarray(bank.weight)
+    for r in range(K):
+        n = int((weight[r] > 0).sum())
+        assert np.all(weight[r, n:] == 0), "empties must be a suffix"
+        assert np.all(np.diff(mean[r, :n]) >= 0), \
+            "positive-weight means must be non-decreasing"

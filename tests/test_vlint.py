@@ -62,6 +62,21 @@ def test_rs01_allows_the_resilience_layer_itself():
     assert [v for v in run_paths([path]) if v.rule == "RS01"] == []
 
 
+def test_sr02_tdigest_bank_writes_outside_owner():
+    # the construction (line 9), the _replace(weight=...) (line 20) and
+    # the statically-opaque **kwargs forms (lines 34/38) are flagged;
+    # the scalar-field _replace and the suppressed write must stay
+    # silent
+    assert lint("sr02_bad.py") == [("SR02", 9), ("SR02", 20),
+                                   ("SR02", 34), ("SR02", 38)]
+
+
+def test_sr02_allows_the_ops_module_itself():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "ops", "tdigest.py")
+    assert [v for v in run_paths([path]) if v.rule == "SR02"] == []
+
+
 def test_clean_fixture_is_clean():
     assert lint("clean.py") == []
 

@@ -34,4 +34,10 @@ DEFAULT_CONFIG = {
     "rs01_allow": (
         "veneur_tpu/resilience.py",
     ),
+    # SR02: the one module allowed to write TDigestBank.mean/weight —
+    # it owns the sorted-prefix invariant the merge-path compress
+    # depends on for correctness.
+    "sr02_allow": (
+        "veneur_tpu/ops/tdigest.py",
+    ),
 }

@@ -1,4 +1,4 @@
-"""Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01.
+"""Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02.
 
 All checks are intentionally conservative: they resolve only what can
 be resolved statically within the project (local jit wrappers, module
@@ -663,6 +663,66 @@ def check_rs01(mod: PyModule, config: dict) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------------------- SR02
+
+_SR02_FIELDS = ("mean", "weight")
+
+
+def check_sr02(mod: PyModule, config: dict) -> list[Violation]:
+    """Sorted-prefix invariant protection: TDigestBank.mean/weight rows
+    must stay exactly as ops/tdigest.py's cluster core emits them
+    (positive-weight means non-decreasing, zero-weight empties last) —
+    the merge-path compress depends on that order for CORRECTNESS, not
+    just speed. Any construction of those fields outside the owning
+    module is flagged: `TDigestBank(...)` calls binding mean/weight
+    (positionally or by keyword) and `<x>._replace(mean=.../weight=...)`
+    — `_replace` with those field names is unambiguous in this codebase
+    (no other bank NamedTuple carries them). Code that provably
+    preserves the order (e.g. an all-zeros prefix) suppresses with a
+    documented reason."""
+    if any(mod.path.endswith(a) for a in config["sr02_allow"]):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is not None and d.rsplit(".", 1)[-1] == "TDigestBank":
+            # kw.arg is None is a **kwargs expansion: statically opaque,
+            # so treated as binding mean/weight (like positional args) —
+            # an invariant gate must not be dodgeable by spelling
+            binds = node.args or any(
+                kw.arg is None or kw.arg in _SR02_FIELDS
+                for kw in node.keywords)
+            if binds:
+                out.append(Violation(
+                    mod.path, node.lineno, "SR02",
+                    "TDigestBank construction outside ops/tdigest.py "
+                    "writes mean/weight — the merge-path compress "
+                    "REQUIRES cluster order on those rows; build banks "
+                    "through the ops module or suppress with a reason "
+                    "proving the order holds"))
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "_replace":
+            fields = sorted(kw.arg for kw in node.keywords
+                            if kw.arg in _SR02_FIELDS)
+            # a **kwargs expansion is statically opaque — it may carry
+            # mean/weight, so it is flagged like an explicit binding
+            # (no such call exists on the clean tree; a non-TDigestBank
+            # one would suppress with its reason)
+            if not fields and any(kw.arg is None for kw in node.keywords):
+                fields = ["**"]
+            if fields:
+                out.append(Violation(
+                    mod.path, node.lineno, "SR02",
+                    f"._replace({', '.join(fields)}=...) outside "
+                    "ops/tdigest.py rewrites t-digest centroid rows — "
+                    "the merge-path compress requires their cluster "
+                    "order; route the write through ops/tdigest.py or "
+                    "suppress with a reason proving the order holds"))
+    return out
+
+
 # ------------------------------------------------------------------- driver
 
 def check_module(mod: PyModule, ctx: Context, config: dict
@@ -674,4 +734,5 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_th01(mod, config))
     out.extend(check_cf01(mod, ctx, config))
     out.extend(check_rs01(mod, config))
+    out.extend(check_sr02(mod, config))
     return out
