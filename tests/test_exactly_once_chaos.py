@@ -1,0 +1,201 @@
+"""End-to-end ambiguous-failure chaos test for the exactly-once
+forward contract.
+
+Topology (all real, in-process): seeded UDP traffic -> local Server
+(real socket, real workers, manual flush ticks) -> ResilientForwarder
+-> HttpJsonForwarder whose egress transport is a ScriptedTransport
+with `deliver=` wired to REAL HTTP POSTs against the global Server's
+/import — so an "ack_lost" step genuinely applies the body at the
+global tier and then drops the response on the way back, exactly the
+failure the sender cannot distinguish from a clean timeout.
+
+The acceptance criterion: after scripted ack-loss / 503 /
+partial-delivery storms (including seeded ambiguous schedules), the
+global tier's flushed state — every t-digest-derived percentile and
+aggregate, the HLL set estimates, the counter sums — is BIT-IDENTICAL
+to a zero-fault oracle run over the same traffic, and the dedupe
+ledger demonstrably fired (duplicates_dropped > 0).
+
+Determinism notes: each round's samples ride in ONE UDP datagram (one
+handle_packet call -> one deterministic ingest order), both servers
+run a single worker queue, flush ticks are manual with pinned
+timestamps, and the egress clock/sleep/rng are all injected fakes.
+The forwarder replays failed intervals oldest-first and parks the
+current interval behind a failed replay, so the global tier Combines
+interval seqs strictly in order — which is what makes bit-identity
+achievable at all (t-digest merges are order-sensitive)."""
+
+import random
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu.cluster.forward import HttpJsonForwarder
+from veneur_tpu.cluster.importsrv import DedupeLedger
+from veneur_tpu.config import read_config
+from veneur_tpu.resilience import (BreakerPolicy, Egress, EgressPolicy,
+                                   ResilienceRegistry,
+                                   ResilientForwarder, RetryPolicy)
+from veneur_tpu.server import Server
+from veneur_tpu.sinks.basic import CaptureMetricSink
+from veneur_tpu.utils.faults import (FakeClock, ScriptedTransport,
+                                     seeded_schedule)
+
+_SERVER_YAML = """
+interval: "3600s"
+num_workers: 1
+percentiles: [0.5, 0.99]
+aggregates: ["min", "max", "count"]
+hostname: h
+tpu_histogram_slots: 512
+tpu_counter_slots: 512
+tpu_gauge_slots: 512
+tpu_set_slots: 256
+tpu_batch_size: 256
+tpu_buffer_depth: 256
+"""
+
+
+class _RoundTransport:
+    """Mutable slot so each chaos round installs a fresh scripted
+    schedule on the same Egress."""
+
+    def __init__(self):
+        self.current = None
+
+    def __call__(self, req, timeout=None):
+        return self.current(req, timeout=timeout)
+
+
+def _mk_global(reg: ResilienceRegistry):
+    cfg = read_config(text=_SERVER_YAML)
+    cfg.http_address = "127.0.0.1:0"
+    cfg.is_global = True
+    sink = CaptureMetricSink()
+    srv = Server(cfg, sinks=[sink], plugins=[])
+    # dedicated registry so local-server self-metric drains between
+    # rounds can't eat the duplicate counters this test asserts on
+    srv.dedupe_ledger = DedupeLedger(registry=reg)
+    srv.start()
+    return srv, sink
+
+
+def _mk_local(forwarder):
+    cfg = read_config(text=_SERVER_YAML)
+    cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    cfg.forward_address = "placeholder:1"   # enables forward exports
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                 forwarder=forwarder)
+    srv.start()
+    return srv
+
+
+def _round_lines(r: int, rng: np.random.Generator) -> bytes:
+    """One round's traffic as a single datagram: 4 timer keys (digest
+    forwards), a set, and two global-only counters — ~9 jsonmetric
+    entries per flush, i.e. 3 wire chunks at max_per_body=3."""
+    lines = []
+    for k in range(4):
+        for v in rng.normal(100 + 10 * k, 5, 5):
+            lines.append(b"chaos.t%d:%.4f|ms" % (k, v))
+    for u in range(3):
+        lines.append(b"chaos.uniq:u%d-%d|s" % (r % 4, u))
+    lines.append(b"chaos.total:%d|c|#veneurglobalonly" % (r + 1))
+    lines.append(b"chaos.extra:2|c|#veneurglobalonly")
+    return b"\n".join(lines)
+
+
+def _run(schedules: list, seed: int = 7):
+    """Drive the full topology over len(schedules) rounds; returns
+    (global flushed metrics, duplicate-drop count, forwarder)."""
+    reg = ResilienceRegistry()
+    glob, _gsink = _mk_global(reg)
+    clock = FakeClock()
+    rt = _RoundTransport()
+    egress = Egress(
+        "chaos-global",
+        policy=EgressPolicy(
+            retry=RetryPolicy(max_attempts=3, base_backoff_s=0.001,
+                              max_backoff_s=0.002, deadline_s=120.0),
+            breaker=BreakerPolicy(failure_threshold=10_000)),
+        transport=rt, clock=clock, sleep=clock.sleep,
+        rng=random.Random(42), registry=reg)
+    base = f"http://127.0.0.1:{glob.http_api.port}"
+    inner = HttpJsonForwarder(base, timeout_s=5.0, max_per_body=3,
+                              egress=egress)
+
+    def deliver(req):
+        return urllib.request.urlopen(req, timeout=5)
+
+    fwd = ResilientForwarder(inner, destination="chaos-global",
+                             sender_id="chaos-sender", registry=reg)
+    local = _mk_local(fwd)
+    try:
+        port = local.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rng = np.random.default_rng(seed)
+        for r, schedule in enumerate(schedules):
+            rt.current = ScriptedTransport(schedule, clock,
+                                           deliver=deliver)
+            c.sendto(_round_lines(r, rng), ("127.0.0.1", port))
+            deadline = time.time() + 10
+            while local.packets_received < 1 and time.time() < deadline:
+                time.sleep(0.005)
+            assert local.packets_received >= 1, "datagram lost"
+            assert local.drain(10.0)
+            local.flush_once(timestamp=1000 + r)   # forward faults are
+            clock.advance(10.0)                    # caught + spilled
+        c.close()
+        assert glob.drain(10.0)
+        out = sorted(
+            (m.name, tuple(m.tags), str(m.type), m.value)
+            for m in glob.flush_once(timestamp=9999)
+            if not m.name.startswith("veneur."))
+        dups = reg.peek("import", "forward.duplicates_dropped")
+        pending = fwd.pending_spill
+    finally:
+        local.stop()
+        glob.stop()
+    return out, dups, pending
+
+
+# the scripted storms: ack-loss (ambiguous), 503 retry ladders, a
+# partial delivery (chunk 1 of 3 dies after being applied), a full
+# outage, recovery, then three seeded ambiguous storms and two clean
+# drain rounds. The oracle run replaces every schedule with ["ok"].
+_CHAOS_SCHEDULES = [
+    ["ok"],
+    ["ack_lost", "ok"],                    # retry after ambiguous loss
+    [503, 503, "ok"],                      # clean retry ladder
+    ["ok", "ack_lost", "timeout", "timeout"],   # partial: applied tail
+    ["refused"],                           # full outage: park + replay
+    ["ok"],                                # recovery: replay storm
+    ["ok"],
+    seeded_schedule(101, 8, p_fail=0.6, ambiguous=True),
+    seeded_schedule(102, 8, p_fail=0.6, ambiguous=True),
+    seeded_schedule(103, 8, p_fail=0.6, ambiguous=True),
+    ["ok"],
+    ["ok"],
+]
+
+
+def test_chaos_state_bit_identical_to_oracle():
+    faulty, dups, pending = _run(_CHAOS_SCHEDULES)
+    oracle, oracle_dups, oracle_pending = _run(
+        [["ok"]] * len(_CHAOS_SCHEDULES))
+    assert pending == 0                 # everything eventually landed
+    assert oracle_pending == 0
+    # the ledger actually fired: ambiguous failures were replayed and
+    # dropped at the receiver, not double-counted
+    assert dups > 0
+    assert oracle_dups == 0
+    # THE criterion: global t-digest/HLL/counter state is bit-identical
+    # — every percentile, aggregate, set estimate and counter sum,
+    # compared exactly (no approx)
+    assert faulty == oracle
+    names = {n for n, _t, _ty, _v in faulty}
+    assert any(n.endswith(".50percentile") for n in names)
+    assert "chaos.uniq" in names and "chaos.total" in names

@@ -295,11 +295,12 @@ class TestSpillBuffer:
 
 
 class TestResilientForwarder:
-    def test_terminal_failure_remerges_matching_oracle(self):
+    def test_terminal_failure_replays_matching_oracle(self):
         """The acceptance criterion: interval A's forward fails
-        terminally; interval B's forward succeeds and must carry A's
-        sketches re-merged, with global quantiles matching the oracle
-        fed both intervals together."""
+        terminally; the next flush replays A under its ORIGINAL
+        envelope (seq 1) before sending B (seq 2) — the receiver
+        Combines both in seq order, with global quantiles matching the
+        oracle fed both intervals together."""
         from veneur_tpu.cluster import wire
         from veneur_tpu.ingest import parser
 
@@ -308,9 +309,13 @@ class TestResilientForwarder:
         a_vals = rng.gamma(2.0, 10.0, 400)
         b_vals = rng.gamma(9.0, 3.0, 400)
 
-        inner = ScriptedCallable([400, "ok"])   # terminal, then good
+        envs = []
+        inner = ScriptedCallable(       # terminal, then good
+            [400, "ok"],
+            on_success=lambda *a, **kw: envs.append(kw.get("envelope")))
         reg = ResilienceRegistry()
         fwd = ResilientForwarder(inner, destination="global",
+                                 sender_id="s", seq_start=1,
                                  registry=reg)
 
         def one_interval(vals, ts):
@@ -325,14 +330,20 @@ class TestResilientForwarder:
         assert reg.peek("global", "spilled") > 0
 
         res_b = one_interval(b_vals, 20)
-        fwd(res_b.export)              # delivers A+B merged
-        assert reg.peek("global", "remerged") > 0
-        (args,), = [c for c in inner.delivered]
+        fwd(res_b.export)              # replays A (seq 1), then sends B
+        assert reg.peek("global", "replayed") > 0
+        assert len(inner.delivered) == 2
+        # the replay kept its original envelope; B got the next seq
+        assert [(e.sender_id, e.interval_seq) for e in envs] == \
+            [("s", 1), ("s", 2)]
+        assert fwd.pending_spill == 0
 
-        # feed the delivered merged export into a fresh global engine
+        # feed the delivered exports into a fresh global engine in
+        # delivery order (A then B — the in-order contract)
         glob = small_engine(is_global=True, forward_enabled=False)
-        for m in wire.export_to_metrics(args):
-            wire.apply_metric_to_engine(glob, m)
+        for (args,) in inner.delivered:
+            for m in wire.export_to_metrics(args):
+                wire.apply_metric_to_engine(glob, m)
         out = {m.name: m.value for m in glob.flush(timestamp=30).metrics}
 
         oracle = OracleDigest()
@@ -354,11 +365,10 @@ class TestResilientForwarder:
         assert reg.peek("d", "spilled") == 0
         assert reg.peek("d", "remerged") == 0
 
-    def test_gauge_ages_out_through_production_merge_spill_cycles(self):
-        """The real outage shape — merge_into then fail then spill,
-        every interval — must still age gauges out: a re-spilled
-        still-undelivered gauge continues its age instead of
-        restarting at 0."""
+    def test_gauge_ages_out_through_production_replay_cycles(self):
+        """The real outage shape — park, replay-fail, park the next
+        interval too, every flush — must still age gauges out of the
+        replay ledger while counters replay lossless."""
         inner = ScriptedCallable(["refused"] * 4 + ["ok"])
         reg = ResilienceRegistry()
         fwd = ResilientForwarder(inner, destination="d",
@@ -371,13 +381,21 @@ class TestResilientForwarder:
         for _ in range(3):   # ages 1, 2, then evicted at 3 > 2
             with pytest.raises(ConnectionRefusedError):
                 fwd(export_of(counters=[(ck, 1.0)]))
-        fwd(export_of(counters=[(ck, 1.0)]))            # delivers
-        (delivered,) = inner.delivered[-1]
-        assert [k for k, _ in delivered.gauges] == []   # aged out
-        assert sum(v for _, v in delivered.counters) == 5.0  # lossless
+        fwd(export_of(counters=[(ck, 1.0)]))  # replays all, then sends
+        assert fwd.pending_spill == 0
+        gauges, counters = [], 0.0
+        for (delivered,) in inner.delivered:
+            gauges.extend(delivered.gauges)
+            counters += sum(v for _, v in delivered.counters)
+        assert gauges == []                             # aged out
+        assert counters == 5.0                          # lossless
         assert reg.peek("d", "spill_evicted") == 1
 
-    def test_fresh_gauge_report_resets_age_mid_outage(self):
+    def test_fresh_gauge_report_outlives_stale_one_mid_outage(self):
+        """A gauge re-reported mid-outage lives in a YOUNGER ledger
+        entry: the stale value ages out of its own entry while the
+        fresh one survives to replay (and, replaying in seq order,
+        would win last-write-wins at the receiver regardless)."""
         inner = ScriptedCallable(["refused"] * 4 + ["ok"])
         fwd = ResilientForwarder(inner, destination="d",
                                  gauge_max_age_intervals=2,
@@ -388,12 +406,13 @@ class TestResilientForwarder:
         with pytest.raises(ConnectionRefusedError):
             fwd(export_of())                            # age 1
         with pytest.raises(ConnectionRefusedError):
-            fwd(export_of(gauges=[(gk, 2.0)]))          # fresh: age 0
+            fwd(export_of(gauges=[(gk, 2.0)]))          # fresh entry
         with pytest.raises(ConnectionRefusedError):
-            fwd(export_of())                            # age 1
+            fwd(export_of())                            # stale evicted
         fwd(export_of())                                # delivers
-        (delivered,) = inner.delivered[-1]
-        assert delivered.gauges == [(gk, 2.0)]          # survived, fresh
+        assert fwd.pending_spill == 0
+        gauges = [g for (d,) in inner.delivered for g in d.gauges]
+        assert gauges == [(gk, 2.0)]          # survived, fresh, LWW-last
 
     def test_partial_delivery_spills_only_the_unsent_tail(self):
         from veneur_tpu.resilience import PartialDeliveryError
@@ -415,9 +434,10 @@ class TestResilientForwarder:
         with pytest.raises(PartialDeliveryError):
             fwd(export_of(counters=[(k1, 3.0), (k2, 7.0)]))
         # only the undelivered entry is pending
-        assert len(fwd.spill) == 1
+        assert fwd.pending_spill == 1
         fwd(export_of())
         assert calls[-1].counters == [(k2, 7.0)]   # no c1 re-send
+        assert fwd.pending_spill == 0
 
     def test_grpc_export_tail_maps_wire_order_back_to_export(self):
         from veneur_tpu.cluster.forward import _export_tail
@@ -557,10 +577,77 @@ tpu_set_slots: 128
             with pytest.raises(ConnectionRefusedError):
                 fwd(export_of(counters=[(ck, 1.0)]))
         fwd(export_of(counters=[(ck, 1.0)]))
-        (delivered,), = [inner.delivered[-1]]
-        # all four intervals' counts present, merged to one entry + the
-        # final interval's own entry
-        assert sum(v for _, v in delivered.counters) == 4.0
+        # all four intervals delivered, in seq order, nothing doubled
+        assert fwd.pending_spill == 0
+        total = sum(v for (d,) in inner.delivered
+                    for _, v in d.counters)
+        assert total == 4.0
+
+    def test_replay_ladder_honors_wall_budget(self, fault_harness):
+        """Regression (review finding): N parked intervals must not
+        stall one flush tick for N x retry_deadline — the ladder stops
+        at replay_budget_s and defers the rest to the next flush."""
+        from veneur_tpu.resilience import TransientEgressError
+
+        h = fault_harness
+
+        def slow_inner(export):
+            h.clock.advance(5.0)     # each replay burns 5 fake seconds
+
+        fwd = ResilientForwarder(slow_inner, destination="d",
+                                 registry=ResilienceRegistry())
+        ck = MetricKey("c", "counter", "")
+        # park 4 intervals (no budget during the outage itself)
+        fail = ResilientForwarder(
+            ScriptedCallable(["refused"]), destination="d",
+            registry=ResilienceRegistry())
+        for entry_vals in range(4):
+            with pytest.raises(ConnectionRefusedError):
+                fail(export_of(counters=[(ck, 1.0)]))
+        fwd._entries = fail._entries           # hand over the backlog
+        fwd.replay_budget_s = 12.0
+        fwd._clock = h.clock
+        t0 = h.clock()
+        with pytest.raises(TransientEgressError, match="budget"):
+            fwd(export_of(counters=[(ck, 1.0)]))
+        # 5s + 5s + 5s > 12s budget: 3 replays ran, ladder stopped,
+        # the rest (plus the parked current interval) wait for the
+        # next flush instead of stalling this one indefinitely
+        assert h.clock() - t0 == pytest.approx(15.0)
+        assert fwd.pending_spill == 2          # 1 deferred + 1 parked
+        # next flush (budget refreshed) drains the remainder
+        fwd(export_of())
+        assert fwd.pending_spill == 0
+
+    def test_ledger_overflow_demotes_oldest_to_merged_tier(self):
+        """Replay entries beyond max_spill_intervals fold into the
+        same-key-merged overflow tier and ride the NEXT interval's
+        fresh envelope (counted as reenveloped — the documented
+        at-least-once degradation)."""
+        envs = []
+        inner = ScriptedCallable(
+            ["refused"] * 4 + ["ok"],
+            on_success=lambda *a, **kw: envs.append(kw.get("envelope")))
+        reg = ResilienceRegistry()
+        fwd = ResilientForwarder(inner, destination="d",
+                                 max_spill_intervals=2, sender_id="s",
+                                 seq_start=1, registry=reg)
+        ck = MetricKey("c", "counter", "")
+        for i in range(4):
+            with pytest.raises(ConnectionRefusedError):
+                fwd(export_of(counters=[(ck, 1.0)]))
+        # 4 failed intervals, ledger bound 2: two demoted and merged
+        assert reg.peek("d", "reenveloped") == 2
+        assert fwd.pending_spill == 3   # 2 entries + 1 merged overflow
+        fwd(export_of(counters=[(ck, 1.0)]))
+        assert fwd.pending_spill == 0
+        total = sum(v for (d,) in inner.delivered
+                    for _, v in d.counters)
+        assert total == 5.0             # lossless through the demotion
+        # replays used original seqs; the merged tier rode the final
+        # interval's fresh envelope
+        seqs = [e.interval_seq for e in envs]
+        assert seqs == sorted(seqs) and seqs[-1] == 5
 
 
 # ------------------------------------------------- server integration

@@ -177,6 +177,82 @@ def test_forwardrpc_metric_list_golden_bytes():
         golden).metrics[0].counter.value == 7
 
 
+# --- idempotency envelope: both forward arms ---
+
+def _golden_envelope_bytes():
+    # forwardrpc.Envelope{sender_id="s1", interval_seq=7,
+    #                     chunk_index=1, chunk_count=3}
+    return (_s(1, "s1")                # sender_id = 1
+            + _vi(2, 7)                # interval_seq = 2 (uint64)
+            + _vi(3, 1)                # chunk_index = 3 (uint32)
+            + _vi(4, 3))               # chunk_count = 4 (uint32)
+
+
+def test_envelope_golden_bytes():
+    env = wire.envelope_pb("s1", 7, 1, 3)
+    golden = _golden_envelope_bytes()
+    assert env.SerializeToString() == golden
+    back = forward_pb2.Envelope.FromString(golden)
+    assert (back.sender_id, back.interval_seq, back.chunk_index,
+            back.chunk_count) == ("s1", 7, 1, 3)
+
+
+def test_send_metrics_envelope_bearing_metric_list_golden_bytes():
+    """The SendMetrics arm: MetricList grew `envelope = 2`; an
+    envelope-bearing payload produced by the ACTUAL forwarder stamping
+    path must serialize to exactly these bytes — and a pre-envelope
+    payload must still parse (HasField false)."""
+    from veneur_tpu.cluster.forward import GrpcForwarder
+    from veneur_tpu.resilience import Egress, ForwardEnvelope
+
+    export = ForwardExport()
+    export.counters.append((MetricKey("c", "counter", ""), 7.0))
+    sent = []
+    fwd = GrpcForwarder("127.0.0.1:1",
+                        egress=Egress("g", transport=lambda *a, **k: None))
+    fwd._send = lambda req, timeout=None: sent.append(req)
+    fwd(export, envelope=ForwardEnvelope("s1", 7, chunk_offset=1,
+                                         chunk_count=3))
+    (ml,) = sent
+    inner = _s(1, "c") + _ld(4, _vi(1, 7)) + _vi(8, 2)
+    golden = (_ld(1, inner)                       # metrics = 1
+              + _ld(2, _golden_envelope_bytes()))  # envelope = 2
+    assert ml.SerializeToString() == golden
+    back = forward_pb2.MetricList.FromString(golden)
+    assert back.HasField("envelope")
+    assert back.envelope.sender_id == "s1"
+    assert back.envelope.interval_seq == 7
+    # legacy payload (no envelope) still parses with HasField false
+    legacy = _ld(1, inner)
+    assert not forward_pb2.MetricList.FromString(
+        legacy).HasField("envelope")
+
+
+def test_send_metrics_v2_envelope_metadata_golden():
+    """The SendMetricsV2 arm is a client stream of bare Metrics — the
+    envelope rides as binary gRPC metadata. Pin the key and the value
+    bytes so neither side can drift."""
+    assert wire.ENVELOPE_METADATA_KEY == "veneur-envelope-bin"
+    value = wire.envelope_pb("s1", 7, 1, 3).SerializeToString()
+    assert value == _golden_envelope_bytes()
+    md = [(wire.ENVELOPE_METADATA_KEY, value)]
+    assert wire.envelope_from_metadata(md) == ("s1", 7, 1, 3)
+
+
+def test_jsonmetric_v1_envelope_headers_golden():
+    """The jsonmetric-v1 arm: envelope fields ride as pinned X-Veneur-*
+    headers in a pinned format."""
+    headers = wire.envelope_headers("s1", 7, 1, 3)
+    assert headers == {"X-Veneur-Sender-Id": "s1",
+                       "X-Veneur-Interval-Seq": "7",
+                       "X-Veneur-Chunk": "1/3"}
+    assert wire.envelope_from_headers(headers) == ("s1", 7, 1, 3)
+    # absent chunk header defaults to the single-chunk interval
+    assert wire.envelope_from_headers(
+        {"X-Veneur-Sender-Id": "s1",
+         "X-Veneur-Interval-Seq": "7"}) == ("s1", 7, 0, 1)
+
+
 # --- SSF: span protobuf + stream frame ---
 
 def _golden_span():

@@ -18,7 +18,8 @@ import logging
 import urllib.request
 
 from ..models.pipeline import ForwardExport
-from ..resilience import (Egress, EgressPolicy, PartialDeliveryError,
+from ..resilience import (Egress, EgressPolicy, ForwardEnvelope,
+                          PartialDeliveryError, accepts_envelope,
                           grpc_channel)
 from . import wire
 from .protos import forward_pb2
@@ -48,32 +49,61 @@ class GrpcForwarder:
             request_serializer=forward_pb2.MetricList.SerializeToString,
             response_deserializer=forward_pb2.Empty.FromString)
 
-    def __call__(self, export: ForwardExport):
+    def __call__(self, export: ForwardExport,
+                 envelope: ForwardEnvelope | None = None):
         """Multi-batch exports fail PRECISELY: a terminal failure after
         some batches landed raises PartialDeliveryError carrying only
-        the unsent tail, so the spill/re-merge layer cannot re-send
-        (and double-count) what the receiver already Combined. All
-        batches share ONE deadline budget — N batches cannot stall the
-        flush tick for N x retry_deadline."""
+        the unsent tail (and how many chunks DID land), so the
+        spill/replay layer resends only undelivered chunks — under the
+        same chunk ids when an `envelope` is given, letting the
+        receiver's dedupe ledger drop anything it already Combined
+        during an ambiguous failure. All batches share ONE deadline
+        budget — N batches cannot stall the flush tick for
+        N x retry_deadline."""
         metrics = wire.export_to_metrics(export)
         deadline = self._egress.deadline()
-        for i in range(0, len(metrics), self.max_per_batch):
+        n_chunks = -(-len(metrics) // self.max_per_batch)
+        total = 0
+        if envelope is not None:
+            total = envelope.chunk_count or (envelope.chunk_offset
+                                             + n_chunks)
+        for j in range(n_chunks):
+            i = j * self.max_per_batch
             batch = forward_pb2.MetricList(
                 metrics=metrics[i:i + self.max_per_batch])
+            if envelope is not None:
+                batch.envelope.CopyFrom(wire.envelope_pb(
+                    envelope.sender_id, envelope.interval_seq,
+                    envelope.chunk_offset + j, total))
             try:
                 self._egress.call(self._send, batch,
                                   timeout_s=self.timeout_s,
                                   deadline=deadline)
             except Exception as e:
-                if i == 0:
+                if j == 0:
                     raise    # nothing delivered: spill the whole export
                 raise PartialDeliveryError(
-                    _export_tail(export, i), e) from e
+                    _export_tail(export, i), e, delivered_chunks=j,
+                    chunk_count=total or n_chunks) from e
 
-    def send_metrics(self, metrics: list):
+    def send_metrics(self, metrics: list, envelope=None):
         """Ship raw metricpb.Metrics (used by the proxy's re-batching),
-        batches retried under one shared deadline budget."""
+        batches retried under one shared deadline budget. `envelope` is
+        a received forwardrpc.Envelope passed through UNMODIFIED (the
+        proxy must not re-stamp chunks it splits — sub-chunking would
+        mint chunk ids the sender never issued and break dedupe). The
+        whole group ships as ONE list under the original ids; that is
+        size-safe because the group is a subset of a single MetricList
+        that already fit through this proxy's inbound gRPC message
+        limit, so it cannot exceed a same-configured outbound limit."""
         deadline = self._egress.deadline()
+        if envelope is not None:
+            batch = forward_pb2.MetricList(metrics=metrics)
+            batch.envelope.CopyFrom(envelope)
+            self._egress.call(self._send, batch,
+                              timeout_s=self.timeout_s,
+                              deadline=deadline)
+            return
         for i in range(0, len(metrics), self.max_per_batch):
             batch = forward_pb2.MetricList(
                 metrics=metrics[i:i + self.max_per_batch])
@@ -122,13 +152,20 @@ class HttpJsonForwarder:
     FORMAT = "jsonmetric-v1"
 
     def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 max_per_body: int = 25_000,
                  egress: Egress | None = None,
                  egress_policy: EgressPolicy | None = None):
         self.url = base_url.rstrip("/") + "/import"
         self.timeout_s = timeout_s
+        self.max_per_body = max_per_body
         self._egress = egress or Egress(self.url, policy=egress_policy)
 
-    def __call__(self, export: ForwardExport):
+    @staticmethod
+    def _body_entries(export: ForwardExport) -> list:
+        """JSONMetric dicts in WIRE ORDER (histograms, sets, counters,
+        gauges) — entry i corresponds 1:1 to metric i of
+        wire.export_to_metrics, so `_export_tail` maps a chunk index
+        back to an export for both contracts identically."""
         body = []
         for key, means, weights, vmin, vmax, vsum, cnt, recip in (
                 export.histograms):
@@ -153,12 +190,43 @@ class HttpJsonForwarder:
             body.append({"name": key.name, "type": "gauge",
                          "tags": wire._split_tags(key.joined_tags),
                          "value": value})
-        req = urllib.request.Request(
-            self.url, data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json",
-                     "X-Veneur-Forward-Version": self.FORMAT},
-            method="POST")
-        self._egress.post(req, timeout_s=self.timeout_s)
+        return body
+
+    def __call__(self, export: ForwardExport,
+                 envelope: ForwardEnvelope | None = None):
+        """Chunked like the gRPC arm (max_per_body entries per POST,
+        one shared deadline budget, PartialDeliveryError carrying the
+        unsent tail + delivered chunk count); each chunk's envelope
+        rides as the X-Veneur-* headers of the jsonmetric-v1
+        contract."""
+        body = self._body_entries(export)
+        deadline = self._egress.deadline()
+        n_chunks = -(-len(body) // self.max_per_body)
+        total = 0
+        if envelope is not None:
+            total = envelope.chunk_count or (envelope.chunk_offset
+                                             + n_chunks)
+        for j in range(n_chunks):
+            i = j * self.max_per_body
+            headers = {"Content-Type": "application/json",
+                       "X-Veneur-Forward-Version": self.FORMAT}
+            if envelope is not None:
+                headers.update(wire.envelope_headers(
+                    envelope.sender_id, envelope.interval_seq,
+                    envelope.chunk_offset + j, total))
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(body[i:i + self.max_per_body]).encode(),
+                headers=headers, method="POST")
+            try:
+                self._egress.post(req, timeout_s=self.timeout_s,
+                                  deadline=deadline)
+            except Exception as e:
+                if j == 0:
+                    raise
+                raise PartialDeliveryError(
+                    _export_tail(export, i), e, delivered_chunks=j,
+                    chunk_count=total or n_chunks) from e
 
 
 class DiscoveringForwarder:
@@ -173,14 +241,22 @@ class DiscoveringForwarder:
     def __init__(self, discoverer, service: str,
                  refresh_interval_s: float = 30.0, use_grpc: bool = True,
                  forwarder_factory=None, timeout_s: float = 10.0,
+                 max_per_body: int = 25_000,
                  egress_policy: EgressPolicy | None = None):
         self.discoverer = discoverer
         self.service = service
         self.refresh_interval_s = refresh_interval_s
         if forwarder_factory is None:
-            leaf = GrpcForwarder if use_grpc else HttpJsonForwarder
-            forwarder_factory = lambda dest: leaf(  # noqa: E731
-                dest, timeout_s=timeout_s, egress_policy=egress_policy)
+            if use_grpc:
+                forwarder_factory = lambda dest: GrpcForwarder(  # noqa: E731
+                    dest, timeout_s=timeout_s,
+                    egress_policy=egress_policy)
+            else:
+                # same body-size knob the direct-address path honors
+                forwarder_factory = lambda dest: HttpJsonForwarder(  # noqa: E731
+                    dest, timeout_s=timeout_s,
+                    max_per_body=max_per_body,
+                    egress_policy=egress_policy)
         self.factory = forwarder_factory
         self._dests: list[str] = []
         self._fwds: dict = {}
@@ -214,7 +290,7 @@ class DiscoveringForwarder:
                     except Exception:
                         pass
 
-    def __call__(self, export):
+    def __call__(self, export, envelope: ForwardEnvelope | None = None):
         self._refresh()
         if not self._dests:
             self.errors += 1
@@ -225,9 +301,30 @@ class DiscoveringForwarder:
             from ..resilience import TransientEgressError
             raise TransientEgressError(
                 f"no forward destinations for {self.service}")
-        dest = self._dests[self._rr % len(self._dests)]
-        self._rr += 1
+        if envelope is not None:
+            # seq-deterministic routing: consecutive intervals still
+            # rotate through the healthy set, but a REPLAY of seq N
+            # lands on the same destination as its first send (as long
+            # as the destination set is stable), so the receiver's
+            # dedupe ledger can actually see the duplicate. Plain
+            # round-robin would replay onto a peer that never saw the
+            # original. Trade-off: a dead destination that discovery
+            # has not pruned yet pins its seqs' replays (its breaker
+            # makes each retry one fast rejection, but the in-order
+            # rule parks current intervals behind the stuck replay);
+            # bounded, because after spill_max_intervals flushes the
+            # stuck entry demotes to the re-enveloped overflow tier —
+            # whose fresh seq maps to a (rotating) healthy peer — and
+            # forwarding resumes. Consul health-checks prune the dead
+            # peer within a refresh interval anyway.
+            dest = self._dests[envelope.interval_seq % len(self._dests)]
+        else:
+            dest = self._dests[self._rr % len(self._dests)]
+            self._rr += 1
         fwd = self._fwds.get(dest)
         if fwd is None:
             fwd = self._fwds[dest] = self.factory(dest)
-        fwd(export)
+        if envelope is not None and accepts_envelope(fwd):
+            fwd(export, envelope=envelope)
+        else:
+            fwd(export)

@@ -7,15 +7,29 @@ Combine kernels (engine.import_*).
 
 Wired with grpc's generic handler API (no grpcio-tools codegen needed):
 method names + message serializers define the service.
+
+Exactly-once: requests carrying an idempotency envelope
+(forwardrpc.Envelope on SendMetrics, the `veneur-envelope-bin`
+metadata header on the SendMetricsV2 stream) are checked against a
+bounded per-sender `DedupeLedger` BEFORE any metric reaches a worker
+queue — a chunk the ledger has already admitted is dropped whole, so a
+sender's retry or spill-replay after an ambiguous failure (body
+Combined, response lost) cannot double-count. Envelope-less requests
+(legacy senders) bypass the ledger and keep the old at-least-once
+contract.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
+import time
+from collections import OrderedDict
 from concurrent import futures
 
 import grpc
 
+from ..resilience import DEFAULT_REGISTRY, ResilienceRegistry
 from ..utils.hashing import metric_digest
 from . import wire
 from .protos import forward_pb2
@@ -32,13 +46,153 @@ class ImportedMetric:
         self.pb = pb
 
 
+class _SenderState:
+    __slots__ = ("watermark", "seqs", "last_seen")
+
+    def __init__(self, now: float):
+        self.watermark = 0          # every seq <= watermark is a dup
+        self.seqs: OrderedDict = OrderedDict()   # seq -> set(chunk_idx)
+        self.last_seen = now
+
+
+class DedupeLedger:
+    """Bounded per-sender replay dedupe for forwarded intervals.
+
+    For each sender the ledger keeps a seq WATERMARK plus the
+    chunk-index sets of the most recent `max_seqs_per_sender`
+    sequences. `admit()` answers "apply or drop?" for one incoming
+    chunk:
+
+      * seq <= watermark          -> drop (an old replay)
+      * chunk already recorded    -> drop (retry / replay duplicate)
+      * otherwise                 -> record and apply
+
+    Bounds (all eviction is counted and documented in README
+    "Exactly-once forward"):
+
+      * per-sender, evicting a seq's chunk set past
+        `max_seqs_per_sender` advances the watermark to it — a replay
+        arriving AFTER that many newer intervals is dropped unseen
+        (bounded under-count, only under a pathological
+        replay-starves-while-newer-delivers pattern; the sender
+        replays oldest-first, which makes it unreachable in practice);
+      * `max_senders` senders, LRU-evicted — a brand-new sender id
+        beyond the bound forgets the coldest sender entirely (its
+        in-flight replays degrade to at-least-once);
+      * a sender idle longer than `ttl_s` is forgotten on the next
+        admit (same degradation; restarted senders use a fresh id, so
+        idle entries are garbage by construction);
+      * one seq's chunk set is capped at MAX_CHUNKS_PER_SEQ (a sane
+        sender ships ~1 chunk per 10-25k metrics; thousands of chunk
+        ids under one seq is a bug or abuse) — hitting the cap evicts
+        the seq to the watermark and rejects the overflow chunk
+        (counted `forward.chunk_overflow`), so a network-facing
+        receiver's memory stays bounded no matter what arrives.
+
+    Thread-safe: gRPC handler threads and HTTP /import handler threads
+    consult the same ledger. The clock is injectable for the fault
+    harness."""
+
+    MAX_CHUNKS_PER_SEQ = 4096
+
+    def __init__(self, max_seqs_per_sender: int = 512,
+                 max_senders: int = 1024, ttl_s: float = 3600.0,
+                 destination: str = "import",
+                 clock=time.monotonic,
+                 registry: ResilienceRegistry | None = None):
+        self.max_seqs_per_sender = max(1, max_seqs_per_sender)
+        self.max_senders = max(1, max_senders)
+        self.ttl_s = ttl_s
+        self.destination = destination
+        self._clock = clock
+        self._registry = registry or DEFAULT_REGISTRY
+        self._lock = threading.Lock()
+        self._senders: OrderedDict[str, _SenderState] = OrderedDict()
+        self._size = 0              # tracked chunk entries, all senders
+
+    def _drop(self, n_chunks: int = 1) -> bool:
+        self._registry.incr(self.destination,
+                            "forward.duplicates_dropped", n_chunks)
+        return False
+
+    def _forget_sender(self, sender_id: str):
+        st = self._senders.pop(sender_id, None)
+        if st is not None:
+            self._size -= sum(len(s) for s in st.seqs.values())
+
+    def admit(self, sender_id: str, seq: int, chunk_index: int,
+              chunk_count: int = 0) -> bool:
+        """True = apply this chunk; False = duplicate, drop it whole."""
+        with self._lock:
+            now = self._clock()
+            # TTL: the LRU end of the sender map is the least recently
+            # seen sender; evict idle ones (restarts use fresh ids)
+            while self._senders:
+                oldest = next(iter(self._senders.values()))
+                if now - oldest.last_seen <= self.ttl_s:
+                    break
+                self._forget_sender(next(iter(self._senders)))
+            st = self._senders.get(sender_id)
+            if st is None:
+                while len(self._senders) >= self.max_senders:
+                    self._forget_sender(next(iter(self._senders)))
+                st = self._senders[sender_id] = _SenderState(now)
+            else:
+                self._senders.move_to_end(sender_id)
+                st.last_seen = now
+            if seq <= st.watermark:
+                return self._drop()
+            chunks = st.seqs.get(seq)
+            if chunks is None:
+                chunks = st.seqs[seq] = set()
+                while len(st.seqs) > self.max_seqs_per_sender:
+                    evicted_seq, evicted = st.seqs.popitem(last=False)
+                    st.watermark = max(st.watermark, evicted_seq)
+                    self._size -= len(evicted)
+            elif chunk_index in chunks:
+                return self._drop()
+            if len(chunks) >= self.MAX_CHUNKS_PER_SEQ:
+                # abuse guard: evict the bloated seq wholesale and
+                # reject the overflow chunk, keeping memory bounded
+                self._size -= len(chunks)
+                del st.seqs[seq]
+                st.watermark = max(st.watermark, seq)
+                self._registry.incr(self.destination,
+                                    "forward.chunk_overflow")
+                return False
+            chunks.add(chunk_index)
+            self._size += 1
+            return True
+
+    def size(self) -> int:
+        """Tracked chunk entries across all senders (the
+        veneur.forward.dedupe_ledger_size gauge)."""
+        with self._lock:
+            return self._size
+
+    def sender_count(self) -> int:
+        with self._lock:
+            return len(self._senders)
+
+    def clear(self):
+        """Teardown: forget everything (graceful shutdown, after
+        in-flight SendMetrics have drained)."""
+        with self._lock:
+            self._senders.clear()
+            self._size = 0
+
+
 class ForwardHandler(grpc.GenericRpcHandler):
     """grpc.GenericRpcHandler serving forwardrpc.Forward."""
 
-    def __init__(self, submit):
+    def __init__(self, submit, ledger: DedupeLedger | None = None,
+                 registry: ResilienceRegistry | None = None):
         """`submit(worker_index_hash, ImportedMetric)` routes one metric;
-        the Server provides a queue-backed implementation."""
+        the Server provides a queue-backed implementation. `ledger`
+        (optional) dedupes envelope-bearing requests."""
         self._submit = submit
+        self._ledger = ledger
+        self._registry = registry or DEFAULT_REGISTRY
 
     def service(self, details):
         from .forward import SEND_METRICS, SEND_METRICS_V2
@@ -55,27 +209,77 @@ class ForwardHandler(grpc.GenericRpcHandler):
         return None
 
     def _route(self, m):
-        key = wire.metric_key_of(m)
-        digest = metric_digest(key.name, key.type, key.joined_tags)
+        # poison-pill guard: one malformed metric (bad key bytes, a
+        # decoder error) must reject THAT metric, not kill the
+        # receive path (veneur.import.rejected_total; the worker-side
+        # Combine guard in server._worker_loop covers decode errors
+        # that only surface at apply time)
+        try:
+            key = wire.metric_key_of(m)
+            digest = metric_digest(key.name, key.type, key.joined_tags)
+        except Exception as e:
+            self._registry.incr("import", "import.rejected")
+            log.warning("rejected unroutable imported metric: %s", e)
+            return
         self._submit(digest, ImportedMetric(m))
 
+    def _admit(self, env) -> bool:
+        if env is None or self._ledger is None:
+            return True
+        return self._ledger.admit(*env)
+
     def _send_metrics(self, request, context):
-        for m in request.metrics:
-            self._route(m)
+        if self._admit(wire.envelope_from_metric_list(request)):
+            for m in request.metrics:
+                self._route(m)
         return forward_pb2.Empty()
 
     def _send_metrics_v2(self, request_iterator, context):
-        for m in request_iterator:
-            self._route(m)
+        md = getattr(context, "invocation_metadata", None)
+        env = wire.envelope_from_metadata(md() if callable(md) else None)
+        if env is None or self._ledger is None:
+            for m in request_iterator:
+                self._route(m)
+            return forward_pb2.Empty()
+        # materialize the stream BEFORE consulting the ledger: if the
+        # client connection dies mid-stream the exception aborts the
+        # RPC with nothing admitted, so the sender's whole-stream retry
+        # under the same envelope still applies (admitting first would
+        # record a half-received chunk as delivered and dedupe the
+        # retry away). The unary arm gets this for free — its request
+        # is fully deserialized before the handler runs.
+        metrics = list(request_iterator)
+        if self._ledger.admit(*env):
+            for m in metrics:
+                self._route(m)
         return forward_pb2.Empty()
 
 
-def start_import_server(address: str, submit, max_workers: int = 8):
+def start_import_server(address: str, submit, max_workers: int = 8,
+                        ledger: DedupeLedger | None = None,
+                        registry: ResilienceRegistry | None = None):
     """Bind a gRPC server for the Forward service; returns (server, port)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((ForwardHandler(submit),))
+    server.add_generic_rpc_handlers(
+        (ForwardHandler(submit, ledger=ledger, registry=registry),))
     port = server.add_insecure_port(address)
     server.start()
     log.info("importsrv listening on %s", address)
     return server, port
+
+
+def stop_import_server(server, grace: float = 5.0, *,
+                       clock=time.monotonic, sleep=time.sleep) -> bool:
+    """Gracefully stop an import server: new RPCs are rejected
+    immediately, in-flight SendMetrics get up to `grace` seconds to
+    complete (so their metrics reach the worker queues and the dedupe
+    ledger records them BEFORE it is torn down). Returns True when the
+    server fully stopped within the grace window. clock/sleep are
+    injectable (fault harness) so the expiry path is testable without
+    real waiting."""
+    done = server.stop(grace)
+    deadline = clock() + grace
+    while not done.is_set() and clock() < deadline:
+        sleep(0.01)
+    return done.is_set()

@@ -15,6 +15,7 @@ import bisect
 import logging
 import threading
 
+from ..resilience import accepts_envelope
 from ..utils.hashing import fnv1a_32
 from . import wire
 from .forward import GrpcForwarder
@@ -127,14 +128,25 @@ class ProxyServer:
 
     def handle_metric_list(self, metric_list):
         """The SendMetrics implementation: fan out groups concurrently
-        (one goroutine per destination in the reference)."""
+        (one goroutine per destination in the reference). An incoming
+        idempotency envelope is passed through UNMODIFIED to every
+        destination's share: the ring split is deterministic, so a
+        sender replay re-splits identically and each global dedupes
+        its own share on the original (sender, seq, chunk) ids."""
+        envelope = (metric_list.envelope
+                    if metric_list.HasField("envelope") else None)
         groups = self.route_metrics(metric_list.metrics)
         errs: list[Exception] = []
         threads = []
         for dest, ms in groups.items():
             def send(dest=dest, ms=ms):
                 try:
-                    self._forwarder_for(dest).send_metrics(ms)
+                    fw = self._forwarder_for(dest)
+                    if envelope is not None and \
+                            accepts_envelope(fw.send_metrics):
+                        fw.send_metrics(ms, envelope=envelope)
+                    else:
+                        fw.send_metrics(ms)
                 except Exception as e:
                     log.warning("proxy forward to %s failed: %s", dest, e)
                     errs.append(e)
@@ -157,7 +169,7 @@ class ProxyServer:
                 from .forward import SEND_METRICS
                 if details.method == SEND_METRICS:
                     return grpc.unary_unary_rpc_method_handler(
-                        lambda req, ctx: self._serve_batch(req),
+                        lambda req, ctx: self._serve_batch(req, ctx),
                         request_deserializer=(
                             forward_pb2.MetricList.FromString),
                         response_serializer=(
@@ -176,8 +188,21 @@ class ProxyServer:
         log.info("proxy listening on %s", address)
         return server, port
 
-    def _serve_batch(self, request):
-        self.handle_metric_list(request)
+    def _serve_batch(self, request, context=None):
+        errs = self.handle_metric_list(request)
+        if errs and context is not None:
+            # a partially-failed fan-out must NOT be acked: the sender
+            # would never replay and the failed destinations' shares
+            # would be lost. Abort retryably instead — the sender's
+            # retry/replay re-splits identically on the ring, and the
+            # destinations that DID succeed dedupe their share on the
+            # passed-through envelope, so nothing double-counts (the
+            # HTTP front's 502 is this same contract).
+            import grpc
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"proxy fan-out failed for {len(errs)} destination(s): "
+                f"{errs[0]}")
         return forward_pb2.Empty()
 
     def stop(self):
@@ -201,14 +226,20 @@ class _JsonDest:
         self.timeout_s = timeout_s
         self._egress = egress or Egress(self.url)
 
-    def send_json(self, dicts: list):
+    def send_json(self, dicts: list, envelope: dict | None = None):
+        """`envelope` is the sender's idempotency headers, passed
+        through UNMODIFIED (see ProxyServer.handle_metric_list — the
+        deterministic ring split makes per-destination dedupe on the
+        original ids sound)."""
         import json as _json
         import urllib.request
+        headers = {"Content-Type": "application/json",
+                   "X-Veneur-Forward-Version": "jsonmetric-v1"}
+        if envelope:
+            headers.update(envelope)
         req = urllib.request.Request(
             self.url, data=_json.dumps(dicts).encode(),
-            headers={"Content-Type": "application/json",
-                     "X-Veneur-Forward-Version": "jsonmetric-v1"},
-            method="POST")
+            headers=headers, method="POST")
         self._egress.post(req, timeout_s=self.timeout_s)
 
 
@@ -242,7 +273,8 @@ class HttpProxyFront:
                 groups.setdefault(ring.get(ring_key), []).append(d)
         return groups
 
-    def handle_batch(self, dicts: list) -> list:
+    def handle_batch(self, dicts: list,
+                     envelope: dict | None = None) -> list:
         groups = self.route_json(dicts)
         # per-thread result slots, aggregated after the join; the shared
         # totals are then bumped under _totals_lock (concurrent POSTs)
@@ -254,7 +286,10 @@ class HttpProxyFront:
                     fw = self._dests.get(dest)
                     if fw is None:
                         fw = self._dests[dest] = self._factory(dest)
-                    fw.send_json(ms)
+                    if envelope and accepts_envelope(fw.send_json):
+                        fw.send_json(ms, envelope=envelope)
+                    else:
+                        fw.send_json(ms)
                 except Exception as e:
                     log.warning("http proxy forward to %s failed: %s",
                                 dest, e)
@@ -313,7 +348,14 @@ class HttpProxyFront:
                     self.send_response(400)
                     self.end_headers()
                     return
-                errs = front.handle_batch(dicts)
+                # idempotency envelope: forwarded verbatim to every
+                # destination's share (dedupe happens at the globals)
+                env = {h: self.headers[h] for h in (
+                    wire.ENVELOPE_SENDER_HEADER,
+                    wire.ENVELOPE_SEQ_HEADER,
+                    wire.ENVELOPE_CHUNK_HEADER)
+                    if self.headers.get(h) is not None}
+                errs = front.handle_batch(dicts, envelope=env or None)
                 self.send_response(502 if errs else 200)
                 self.end_headers()
 

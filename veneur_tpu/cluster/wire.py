@@ -12,9 +12,90 @@ import numpy as np
 from ..ingest.parser import (GLOBAL_ONLY, LOCAL_ONLY, MIXED_SCOPE,
                              MetricKey)
 from ..models.pipeline import ForwardExport
-from .protos import metric_pb2
+from .protos import forward_pb2, metric_pb2
 
 HLL_VERSION = 1
+
+# ---- idempotency envelope (exactly-once forward) ----
+#
+# Every forwarded chunk carries (sender_id, interval_seq, chunk_index,
+# chunk_count) so the receiving global tier can drop replays: the
+# forwardrpc contract embeds a forwardrpc.Envelope (SendMetrics) or a
+# binary metadata header (SendMetricsV2, streaming — there is no
+# request message to hang it on); the jsonmetric-v1 contract carries
+# the same four fields as HTTP headers. The encode helpers here are
+# the ONLY place the field<->header mapping lives; the import server
+# and the HTTP /import handler decode through the matching helpers so
+# the two directions cannot drift (mirrored-arm parity:
+# tests/test_exactly_once.py TestEnvelopeEncodeDecodeParity; pinned
+# bytes/headers: tests/test_wire_golden.py).
+
+ENVELOPE_METADATA_KEY = "veneur-envelope-bin"   # gRPC metadata, serialized Envelope
+ENVELOPE_SENDER_HEADER = "X-Veneur-Sender-Id"
+ENVELOPE_SEQ_HEADER = "X-Veneur-Interval-Seq"
+ENVELOPE_CHUNK_HEADER = "X-Veneur-Chunk"        # "<index>/<count>"
+
+
+def envelope_pb(sender_id: str, interval_seq: int, chunk_index: int,
+                chunk_count: int):
+    return forward_pb2.Envelope(
+        sender_id=sender_id, interval_seq=int(interval_seq),
+        chunk_index=int(chunk_index), chunk_count=int(chunk_count))
+
+
+def envelope_headers(sender_id: str, interval_seq: int, chunk_index: int,
+                     chunk_count: int) -> dict:
+    """The jsonmetric-v1 header encoding of one chunk's envelope."""
+    return {ENVELOPE_SENDER_HEADER: sender_id,
+            ENVELOPE_SEQ_HEADER: str(int(interval_seq)),
+            ENVELOPE_CHUNK_HEADER:
+                f"{int(chunk_index)}/{int(chunk_count)}"}
+
+
+def envelope_from_headers(headers) -> tuple | None:
+    """Decode (sender_id, interval_seq, chunk_index, chunk_count) from a
+    mapping with .get (http.server headers, a plain dict). Returns None
+    when no envelope was sent (legacy senders — dedupe is skipped);
+    raises ValueError on a malformed one (the receiver 400s rather than
+    mis-applying it)."""
+    def _get(name):
+        v = headers.get(name)
+        # urllib's Request stores header keys str.capitalize()d;
+        # http.server's Message is case-insensitive already
+        return v if v is not None else headers.get(name.capitalize())
+
+    sender = _get(ENVELOPE_SENDER_HEADER)
+    seq = _get(ENVELOPE_SEQ_HEADER)
+    chunk = _get(ENVELOPE_CHUNK_HEADER)
+    if sender is None and seq is None and chunk is None:
+        return None
+    if not sender or seq is None:
+        raise ValueError("incomplete forward envelope headers")
+    try:
+        idx, _, cnt = (chunk or "0/1").partition("/")
+        return (sender, int(seq), int(idx), int(cnt or 1))
+    except ValueError:
+        raise ValueError(f"malformed forward envelope: seq={seq!r} "
+                         f"chunk={chunk!r}") from None
+
+
+def envelope_from_metric_list(ml) -> tuple | None:
+    """Envelope of a forwardrpc.MetricList, or None (legacy sender)."""
+    if not ml.HasField("envelope"):
+        return None
+    e = ml.envelope
+    return (e.sender_id, e.interval_seq, e.chunk_index, e.chunk_count)
+
+
+def envelope_from_metadata(metadata) -> tuple | None:
+    """Envelope of a SendMetricsV2 stream's invocation metadata
+    (an iterable of (key, value) pairs), or None."""
+    for key, value in metadata or ():
+        if key == ENVELOPE_METADATA_KEY:
+            e = forward_pb2.Envelope.FromString(value)
+            return (e.sender_id, e.interval_seq, e.chunk_index,
+                    e.chunk_count)
+    return None
 
 _TYPE_TO_PB = {
     "counter": metric_pb2.Counter,

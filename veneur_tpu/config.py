@@ -88,6 +88,21 @@ class Config:
     # interval (lossless for counters/histos/sets; gauges age out)
     spill_max_sketches: int = 65536
     spill_gauge_max_age_intervals: int = 4
+    # failed intervals kept as distinct replay-ledger entries (each
+    # replayed under its ORIGINAL idempotency envelope — exactly-once);
+    # older entries fold into the merged spill tier above (at-least-once)
+    spill_max_intervals: int = 8
+
+    # --- exactly-once forward (idempotency envelope + dedupe ledger) ---
+    # Sender identity stamped on every forwarded chunk. Default "" =
+    # a fresh <hostname>-<pid>-<rand> per process start, so a restart
+    # can never collide with its predecessor's receiver-side ledger.
+    forward_sender_id: str = ""
+    # Receiver side: the global tier's per-sender dedupe ledger.
+    forward_dedupe_enabled: bool = True
+    forward_dedupe_max_seqs_per_sender: int = 512
+    forward_dedupe_max_senders: int = 1024
+    forward_dedupe_ttl: str = "1h"   # idle senders forgotten after this
 
     # --- TLS (statsd/SSF stream listeners) ---
     tls_key: str = ""
@@ -237,13 +252,15 @@ def _validate(cfg: Config) -> None:
         raise ValueError(f"interval must be positive: {cfg.interval!r}")
     for key in ("flush_timeout", "retry_backoff_base",
                 "retry_backoff_cap", "retry_deadline",
-                "breaker_open_duration"):
+                "breaker_open_duration", "forward_dedupe_ttl"):
         if _parse_interval(getattr(cfg, key)) <= 0:
             raise ValueError(
                 f"{key} must be a positive duration: "
                 f"{getattr(cfg, key)!r}")
     for key in ("retry_max_attempts", "breaker_failure_threshold",
-                "breaker_half_open_successes"):
+                "breaker_half_open_successes", "spill_max_intervals",
+                "forward_dedupe_max_seqs_per_sender",
+                "forward_dedupe_max_senders"):
         if getattr(cfg, key) < 1:
             raise ValueError(f"{key} must be >= 1")
     if cfg.spill_max_sketches < 0 or \

@@ -71,11 +71,13 @@ class HttpApi:
     """The ops HTTP listener; `submit(digest, pb_metric)` routes an
     imported metric onto a worker queue (the Server provides it)."""
 
-    def __init__(self, address: str, submit=None, healthy=None):
+    def __init__(self, address: str, submit=None, healthy=None,
+                 ledger=None):
         host, _, port = address.rpartition(":")
         host = host.strip("[]") or "0.0.0.0"
         self._submit = submit
         self._healthy = healthy or (lambda: True)
+        self._ledger = ledger   # cluster.importsrv.DedupeLedger or None
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -127,6 +129,19 @@ class HttpApi:
                     self._reply(400, f"unsupported forward format "
                                      f"{ver!r}\n".encode())
                     return
+                # idempotency envelope (exactly-once forward): decoded
+                # up front so a malformed one 400s before any work, but
+                # NOT admitted to the ledger until the body has fully
+                # decoded — admitting first would record a chunk whose
+                # read/parse then failed as "applied", and the sender's
+                # safe re-send (a 400 promises nothing was imported)
+                # would be dropped as a duplicate.
+                try:
+                    env = wire.envelope_from_headers(self.headers)
+                except ValueError as e:
+                    self._reply(400, f"bad forward envelope: "
+                                     f"{e}\n".encode())
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n))
@@ -145,6 +160,16 @@ class HttpApi:
                         decoded.append((digest, pb))
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply(400, f"bad import body: {e}\n".encode())
+                    return
+                # payload fully in hand: NOW consult the ledger — a
+                # chunk it has already admitted is dropped WHOLE, with
+                # a 200 (the sender delivered it, it just can't know
+                # that yet: the ambiguous-failure replay path)
+                if env is not None and api._ledger is not None \
+                        and not api._ledger.admit(*env):
+                    self._reply(200, json.dumps(
+                        {"imported": 0, "deduped": True}).encode(),
+                        "application/json")
                     return
                 count = 0
                 for digest, pb in decoded:

@@ -28,16 +28,26 @@ lack:
     Half-open admits ONE in-flight probe at a time; concurrent callers
     are rejected until the probe resolves.
 
-  * **A bounded re-merge spill buffer** (`SpillBuffer` +
-    `ResilientForwarder`): when a forward fails terminally, the
-    interval's `ForwardExport` sketches are NOT dropped — they are
-    spilled and merged into the next interval's export. t-digest
-    centroids concatenate (the receiver's Combine re-clusters), HLL
-    registers fold by max, counters sum: all lossless. Gauges are
-    last-write-wins and only meaningful fresh, so they ride along for
-    `gauge_max_age_intervals` failed intervals and are then evicted
-    (counted). The budget bounds total spilled entries; overflow evicts
-    oldest sketches first, also counted.
+  * **An exactly-once spill/replay ledger** (`ResilientForwarder` +
+    `SpillBuffer`): every interval's forward is stamped with an
+    idempotency envelope (`ForwardEnvelope`: stable sender_id,
+    monotonic interval_seq, chunk ids). When a forward fails
+    terminally, the interval's `ForwardExport` sketches are NOT
+    dropped — they are parked in a bounded replay ledger KEEPING their
+    original envelope, and replayed oldest-first ahead of the next
+    interval's send. The receiving global tier keeps a per-sender
+    dedupe ledger (`cluster.importsrv.DedupeLedger`) and drops any
+    chunk it already Combined, so an *ambiguous* failure (body
+    applied, response lost) followed by a retry or replay cannot
+    double-count. Ledger overflow demotes the oldest intervals into
+    the same-key-merged `SpillBuffer` overflow tier (centroids
+    concatenate, HLL registers fold by max, counters sum — lossless),
+    whose contents ride the next interval's fresh envelope: those
+    sketches degrade to at-least-once, counted as `reenveloped`.
+    Gauges are last-write-wins and only meaningful fresh, so they ride
+    along for `gauge_max_age_intervals` failed intervals and are then
+    evicted (counted). The sketch budget bounds both tiers; overflow
+    evicts oldest sketches first, also counted.
 
 Everything observable is counted per destination in a
 `ResilienceRegistry`; the server drains it each flush into
@@ -83,11 +93,18 @@ class CircuitOpenError(EgressError):
 class PartialDeliveryError(EgressError):
     """Part of an export was delivered before a terminal failure; only
     `undelivered` may be spilled for re-merge — re-sending the whole
-    export would double-count counters at the receiver's Combine."""
+    export would double-count counters at the receiver's Combine.
+    `delivered_chunks`/`chunk_count` record where in the interval's
+    chunk sequence the failure hit, so the replay can resend the tail
+    under the SAME chunk ids (the receiver's dedupe ledger then drops
+    a chunk that was ambiguously applied before the failure)."""
 
-    def __init__(self, undelivered, cause: BaseException | None = None):
+    def __init__(self, undelivered, cause: BaseException | None = None,
+                 delivered_chunks: int = 0, chunk_count: int = 0):
         super().__init__(f"partial delivery: {cause}")
         self.undelivered = undelivered
+        self.delivered_chunks = delivered_chunks
+        self.chunk_count = chunk_count
 
 
 class HTTPStatusError(EgressError):
@@ -140,6 +157,62 @@ def is_retryable(exc: BaseException) -> bool:
     if isinstance(exc, OSError):
         return True
     return False
+
+
+# ------------------------------------------------------------ envelope
+
+@dataclass(frozen=True)
+class ForwardEnvelope:
+    """Idempotency identity of one interval's forward. The leaf
+    forwarder stamps every wire chunk it emits with
+    (sender_id, interval_seq, chunk_offset + j, chunk_count) — the
+    receiver's dedupe ledger drops a chunk it has already Combined, so
+    a retry or replay after an ambiguous failure (body applied,
+    response lost) cannot double-count. chunk_count == 0 lets the leaf
+    compute the total from its own chunking (the whole-interval case);
+    a replayed partial tail carries the ORIGINAL total so its chunk ids
+    line up with what the receiver already saw."""
+
+    sender_id: str
+    interval_seq: int
+    chunk_offset: int = 0
+    chunk_count: int = 0
+
+
+def accepts_envelope(fn) -> bool:
+    """Does a forwarder callable take an `envelope=` kwarg? Cached on
+    the callable; plain test doubles and legacy forwarders that only
+    take (export) keep working — they just forward un-enveloped
+    (receiver applies everything: at-least-once, the old contract)."""
+    # cache on the underlying function for bound methods — a method
+    # object is recreated on every attribute access (and refuses
+    # attribute writes), so caching on `fn` itself would re-run
+    # signature introspection per call on the proxy fan-out hot path
+    target = getattr(fn, "__func__", fn)
+    cached = getattr(target, "_veneur_accepts_envelope", None)
+    if cached is None:
+        import inspect
+        try:
+            params = inspect.signature(fn).parameters.values()
+            cached = any(p.name == "envelope"
+                         or p.kind == p.VAR_KEYWORD for p in params)
+        except (TypeError, ValueError):
+            cached = False
+        try:
+            target._veneur_accepts_envelope = cached
+        except AttributeError:
+            pass
+    return cached
+
+
+def new_sender_id(hostname: str = "") -> str:
+    """Default forward sender id: unique per process incarnation so a
+    restart cannot collide with its predecessor's ledger entries (the
+    old id's receiver state just ages out via the dedupe TTL)."""
+    import os
+    import uuid
+    base = hostname or "veneur"
+    return f"{base}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
 
 
 # ------------------------------------------------------------- policies
@@ -583,21 +656,96 @@ class SpillBuffer:
         return export
 
 
+def _export_size(export) -> int:
+    return (len(export.histograms) + len(export.sets)
+            + len(export.counters) + len(export.gauges))
+
+
+class _ReplayEntry:
+    """One failed interval awaiting replay under its ORIGINAL envelope.
+    `chunk_offset`/`chunk_count` track partial-delivery progress: a
+    tail replay carries the same chunk ids the first send used, so the
+    receiver's ledger can drop a chunk that was ambiguously applied."""
+
+    __slots__ = ("seq", "chunk_offset", "chunk_count", "export", "age")
+
+    def __init__(self, seq, export, chunk_offset=0, chunk_count=0):
+        self.seq = seq
+        self.export = export
+        self.chunk_offset = chunk_offset
+        self.chunk_count = chunk_count
+        self.age = 0   # failed flushes survived (gauge eviction clock)
+
+
 class ResilientForwarder:
-    """Wraps the server's forwarder callable with the spill/re-merge
-    contract: pending sketches from failed intervals are merged into
-    each outgoing export; a failing send (terminal — the inner
-    forwarder owns its own retry/breaker) spills the merged export
-    back. Called only from the flusher thread, like the forwarder it
-    wraps."""
+    """Wraps the server's forwarder callable with the exactly-once
+    spill/replay contract. Each interval's export is stamped with a
+    fresh `ForwardEnvelope` (monotonic interval_seq under a stable
+    sender_id); a failing send (terminal — the inner forwarder owns
+    its own retry/breaker) parks the interval in a bounded replay
+    ledger KEEPING that envelope. The next flush replays pending
+    intervals oldest-first, each under its original ids, before the
+    current interval goes out — so the receiver Combines seqs strictly
+    in order (the bit-identical re-merge argument needs ordered
+    Combine) and its dedupe ledger drops anything it already applied
+    during an ambiguous failure. A replay failure stops the ladder:
+    the current export is parked unsent rather than delivered out of
+    order.
+
+    The ledger holds at most `max_spill_intervals` entries /
+    `max_spill_sketches` sketches; overflow demotes the OLDEST entries
+    into the same-key-merged SpillBuffer, whose contents ride the
+    current interval's fresh envelope instead (`reenveloped` counted:
+    those sketches degrade to the old at-least-once contract — a
+    duplicate is possible only if their original failure was ambiguous
+    AND the outage outlived the ledger). Called only from the flusher
+    thread, like the forwarder it wraps."""
 
     def __init__(self, inner, destination: str = "forward",
                  max_spill_sketches: int = 65536,
                  gauge_max_age_intervals: int = 4,
+                 max_spill_intervals: int = 8,
+                 sender_id: str | None = None,
+                 seq_start: int | None = None,
+                 replay_budget_s: float | None = None,
+                 clock=time.monotonic,
                  registry: ResilienceRegistry | None = None):
+        """`seq_start` seeds the interval_seq space. Auto-generated
+        sender ids are unique per process incarnation, so they start at
+        1; a CONFIGURED (stable) sender_id MUST seed from wall time —
+        a restart that reset to 1 would put every new seq below the
+        receiver ledger's persisted watermark for that sender and
+        blackhole all forwards until the dedupe TTL (the sender keeps
+        sending, so last_seen stays fresh and idle eviction never
+        fires). Wall MILLISECONDS: seqs advance 1/interval per second
+        while the seed advances 1000/s, so a restart's seed outruns the
+        previous incarnation's watermark for any flush interval > 1ms
+        (seconds-granularity seeding would lose that race below 1s
+        intervals)."""
         self.inner = inner
         self.destination = destination
         self.registry = registry or DEFAULT_REGISTRY
+        if sender_id:
+            self.sender_id = sender_id
+            if seq_start is None:
+                seq_start = int(time.time() * 1000)
+        else:
+            self.sender_id = new_sender_id()
+        self.max_spill_intervals = max(1, max_spill_intervals)
+        self.max_spill_sketches = max_spill_sketches
+        self.gauge_max_age = gauge_max_age_intervals
+        # wall budget for ONE flush's whole replay ladder: without it,
+        # max_spill_intervals slow-failing replays could each burn a
+        # full inner retry_deadline and stall the flush tick for
+        # N x deadline — the exact unbounded-stall shape the egress
+        # layer's shared batch deadline exists to prevent. None = no
+        # budget (unit-test / library use); the server wires
+        # 2 x retry_deadline.
+        self.replay_budget_s = replay_budget_s
+        self._clock = clock
+        self._takes_envelope = accepts_envelope(inner)
+        self._next_seq = seq_start if seq_start is not None else 1
+        self._entries: list[_ReplayEntry] = []
         self.spill = SpillBuffer(
             max_sketches=max_spill_sketches,
             gauge_max_age_intervals=gauge_max_age_intervals,
@@ -605,28 +753,137 @@ class ResilientForwarder:
 
     @property
     def pending_spill(self) -> int:
-        """Sketches awaiting re-merge; the server forwards even an
-        otherwise-empty interval while this is nonzero, so spilled data
-        cannot strand when traffic stops."""
-        return len(self.spill)
+        """Sketches awaiting replay or re-merge; the server forwards
+        even an otherwise-empty interval while this is nonzero, so
+        spilled data cannot strand when traffic stops."""
+        return sum(_export_size(e.export) for e in self._entries) \
+            + len(self.spill)
+
+    def _send(self, export, envelope: ForwardEnvelope):
+        if self._takes_envelope:
+            self.inner(export, envelope=envelope)
+        else:
+            self.inner(export)
+
+    def _park(self, seq, export, chunk_offset=0, chunk_count=0):
+        n = _export_size(export)
+        if n == 0:
+            return 0
+        self._entries.append(
+            _ReplayEntry(seq, export, chunk_offset, chunk_count))
+        self.registry.incr(self.destination, "spilled", n)
+        self._enforce_ledger_budget()
+        return n
+
+    def _enforce_ledger_budget(self):
+        """Demote oldest entries to the merged overflow tier until the
+        replay ledger fits its interval/sketch bounds."""
+        def total():
+            return sum(_export_size(e.export) for e in self._entries)
+        while self._entries and (
+                len(self._entries) > self.max_spill_intervals
+                or total() > self.max_spill_sketches):
+            entry = self._entries.pop(0)
+            self.registry.incr(self.destination, "reenveloped",
+                               _export_size(entry.export))
+            # SpillBuffer.spill counts these under "spilled" again;
+            # compensate so spilled_total keeps meaning "sketches that
+            # entered the resilience layer", not internal shuffles
+            added = self.spill.spill(entry.export)
+            self.registry.incr(self.destination, "spilled", -added)
+
+    def _age_entries(self):
+        """One failed flush elapsed with these entries still pending:
+        age them, and strip over-age gauges (last-write-wins data is
+        only meaningful fresh). Gauges sit at the TAIL of the wire
+        order, so stripping them never shifts an earlier metric across
+        a frozen chunk boundary of a partially-delivered entry."""
+        evicted = 0
+        for entry in list(self._entries):
+            entry.age += 1
+            if entry.age > self.gauge_max_age and entry.export.gauges:
+                evicted += len(entry.export.gauges)
+                entry.export.gauges[:] = []
+                if _export_size(entry.export) == 0:
+                    self._entries.remove(entry)
+        self.registry.incr(self.destination, "spill_evicted", evicted)
 
     def __call__(self, export):
+        reg, dest = self.registry, self.destination
+        replay_err = None
+        # -- replay phase: pending intervals first, oldest seq first,
+        # under their ORIGINAL envelopes; stop at the first failure so
+        # the receiver observes seqs strictly in order.
+        budget_deadline = (None if self.replay_budget_s is None
+                           else self._clock() + self.replay_budget_s)
+        while self._entries and replay_err is None:
+            if budget_deadline is not None \
+                    and self._clock() >= budget_deadline:
+                replay_err = TransientEgressError(
+                    f"{dest}: replay ladder budget "
+                    f"({self.replay_budget_s:.1f}s) exhausted; "
+                    f"{len(self._entries)} intervals deferred to the "
+                    "next flush")
+                break
+            entry = self._entries[0]
+            env = ForwardEnvelope(self.sender_id, entry.seq,
+                                  entry.chunk_offset, entry.chunk_count)
+            try:
+                self._send(entry.export, env)
+            except PartialDeliveryError as e:
+                entry.export = e.undelivered
+                entry.chunk_offset += e.delivered_chunks
+                if e.chunk_count:
+                    entry.chunk_count = e.chunk_count
+                replay_err = e
+            except Exception as e:
+                replay_err = e
+            else:
+                reg.incr(dest, "replayed", _export_size(entry.export))
+                self._entries.pop(0)
+        if replay_err is not None:
+            # park the current interval unsent: delivering it ahead of
+            # the failed replay would reorder seqs at the receiver.
+            # The overflow tier stays put — absorbing it here would
+            # just bounce its sketches back into the ledger.
+            if _export_size(export):
+                seq = self._next_seq
+                self._next_seq += 1
+                self._park(seq, export)
+            self._age_entries()
+            log.warning(
+                "forward to %s failed on replay; current interval "
+                "parked for in-order retry (%d sketches pending)",
+                dest, self.pending_spill)
+            raise replay_err
+        # -- overflow tier: sketches that outlived the replay ledger
+        # ride the CURRENT interval's envelope (their at-least-once
+        # degradation was already counted as reenveloped)
         export = self.spill.merge_into(export)
+        if _export_size(export) == 0:
+            return
+        seq = self._next_seq
+        self._next_seq += 1
         try:
-            self.inner(export)
+            self._send(export, ForwardEnvelope(self.sender_id, seq))
         except PartialDeliveryError as e:
-            # some batches landed: spill only what didn't
-            n = self.spill.spill(e.undelivered)
+            # some chunks landed: park only what didn't, resuming at
+            # the failed chunk's id
+            n = self._park(seq, e.undelivered,
+                           chunk_offset=e.delivered_chunks,
+                           chunk_count=e.chunk_count)
+            self._age_entries()
             log.warning(
                 "forward to %s partially failed; %d undelivered "
-                "sketches spilled for re-merge into the next interval",
-                self.destination, n)
+                "sketches parked for replay under their original "
+                "envelope", dest, n)
             raise
         except Exception:
-            n = self.spill.spill(export)
+            n = self._park(seq, export)
+            self._age_entries()
             log.warning(
-                "forward to %s failed; %d sketches spilled for "
-                "re-merge into the next interval", self.destination, n)
+                "forward to %s failed; %d sketches parked for replay "
+                "under their original envelope", dest, n)
             raise
 
     def close(self):

@@ -125,6 +125,7 @@ class Server:
                 forwarder = HttpJsonForwarder(
                     cfg.forward_address,
                     timeout_s=cfg.flush_timeout_seconds,
+                    max_per_body=cfg.flush_max_per_body,
                     egress_policy=self._egress_policy)
         elif forwarder is None and cfg.consul_forward_service_name:
             # discover the global tier via Consul and re-resolve on the
@@ -138,6 +139,7 @@ class Server:
                     cfg.consul_refresh_interval),
                 use_grpc=cfg.forward_use_grpc,
                 timeout_s=cfg.flush_timeout_seconds,
+                max_per_body=cfg.flush_max_per_body,
                 egress_policy=self._egress_policy)
         if forwarder is not None and not isinstance(
                 forwarder, resilience.ResilientForwarder):
@@ -151,8 +153,29 @@ class Server:
                              or "forward"),
                 max_spill_sketches=cfg.spill_max_sketches,
                 gauge_max_age_intervals=(
-                    cfg.spill_gauge_max_age_intervals))
+                    cfg.spill_gauge_max_age_intervals),
+                max_spill_intervals=cfg.spill_max_intervals,
+                sender_id=(cfg.forward_sender_id or
+                           resilience.new_sender_id(self.hostname)),
+                # one wall budget for the whole replay ladder (plus the
+                # current send's own retry_deadline): a flush tick can
+                # stall at most ~3x retry_deadline, not
+                # spill_max_intervals x retry_deadline
+                replay_budget_s=2 * _parse_interval(cfg.retry_deadline))
         self.forwarder = forwarder   # callable(ForwardExport) or None
+        # Receiver side of the exactly-once contract: one dedupe ledger
+        # shared by the gRPC importsrv and the HTTP /import path, so a
+        # sender that fails over between contracts still dedupes.
+        self.dedupe_ledger = None
+        if cfg.forward_dedupe_enabled and (
+                cfg.grpc_listen_addresses or cfg.http_address
+                or cfg.is_global):
+            from .cluster.importsrv import DedupeLedger
+            self.dedupe_ledger = DedupeLedger(
+                max_seqs_per_sender=(
+                    cfg.forward_dedupe_max_seqs_per_sender),
+                max_senders=cfg.forward_dedupe_max_senders,
+                ttl_s=_parse_interval(cfg.forward_dedupe_ttl))
         self._grpc_servers = []
         # tags_exclude strips tag names BEFORE key construction (metrics
         # differing only in an excluded tag aggregate together), in both
@@ -207,6 +230,7 @@ class Server:
         self.spans_received = 0
         self.ssf_errors = 0
         self.flush_errors = 0
+        self.import_rejected = 0
         self._last_forward_err = None   # sentry dedupe, under _stats_lock
         self._stats_lock = threading.Lock()
         # SSF span pipeline (SpanWorker + SpanSinks)
@@ -464,18 +488,31 @@ class Server:
             t.start()
             self._threads.append(t)
 
-    def stop(self):
+    def stop(self, *, grace: float | None = None, clock=time.monotonic,
+             sleep=time.sleep):
         self._stop.set()
         if getattr(self, "http_api", None) is not None:
             try:
                 self.http_api.stop()
             except Exception:
                 pass
+        # graceful importsrv shutdown: reject new RPCs immediately but
+        # let in-flight SendMetrics finish routing onto the worker
+        # queues — their chunks are already recorded in the dedupe
+        # ledger, so killing them mid-stream would strand entries the
+        # sender will never replay. clock/sleep are injectable (fault
+        # harness) so the grace-expiry path is testable without real
+        # waiting.
+        from .cluster.importsrv import stop_import_server
+        if grace is None:
+            grace = min(2.0, self.cfg.interval_seconds)
         for g in self._grpc_servers:
             try:
-                g.stop(0.5)
+                stop_import_server(g, grace, clock=clock, sleep=sleep)
             except Exception:
                 pass
+        if self.dedupe_ledger is not None:
+            self.dedupe_ledger.clear()   # torn down only after drain
         for q in self.worker_queues:
             try:
                 q.put_nowait(_STOP)
@@ -880,7 +917,8 @@ class Server:
                 with self._stats_lock:
                     self.queue_drops += 1
 
-        server, port = start_import_server(addr, submit)
+        server, port = start_import_server(
+            addr, submit, ledger=self.dedupe_ledger)
         self._grpc_servers.append(server)
         self.grpc_port = port
 
@@ -901,7 +939,8 @@ class Server:
                 with self._stats_lock:
                     self.queue_drops += 1
 
-        self.http_api = HttpApi(addr, submit=submit)
+        self.http_api = HttpApi(addr, submit=submit,
+                                ledger=self.dedupe_ledger)
         self.http_api.start()
 
     def bound_port(self) -> int:
@@ -957,7 +996,19 @@ class Server:
                 if isinstance(item, parser.UDPMetric):
                     eng.process(item)
                 elif isinstance(item, ImportedMetric):
-                    apply_metric_to_engine(eng, item.pb)
+                    # poison-pill guard: a corrupted forwarded payload
+                    # (bad HLL blob, malformed centroid list) must
+                    # reject THAT metric, not kill this worker loop —
+                    # without the catch, one bad sender starves a
+                    # whole queue shard forever
+                    try:
+                        apply_metric_to_engine(eng, item.pb)
+                    except Exception as e:
+                        with self._stats_lock:
+                            self.import_rejected += 1
+                        log.warning(
+                            "rejected corrupted imported metric "
+                            "%r: %s", getattr(item.pb, "name", "?"), e)
                 elif isinstance(item, parser.Event):
                     eng.process_event(item)
                 else:
@@ -1109,6 +1160,7 @@ class Server:
             spans, self.spans_received = self.spans_received, 0
             sserrs, self.ssf_errors = self.ssf_errors, 0
             flerrs, self.flush_errors = self.flush_errors, 0
+            imprej, self.import_rejected = self.import_rejected, 0
         if self.native_bridge is not None:
             # UDP in native mode is counted in the bridge; fold in the
             # per-interval deltas. Drop taxonomy: ring/backpressure
@@ -1149,7 +1201,12 @@ class Server:
             mk("veneur.ssf.error_total", sserrs, MetricType.COUNTER),
             mk("veneur.flush.total_duration_ns", dur_ns, MetricType.GAUGE),
             mk("veneur.flush.error_total", flerrs, MetricType.COUNTER),
+            mk("veneur.import.rejected_total", imprej,
+               MetricType.COUNTER),
         ]
+        if self.dedupe_ledger is not None:
+            out.append(mk("veneur.forward.dedupe_ledger_size",
+                          self.dedupe_ledger.size(), MetricType.GAUGE))
         if eng_stats is not None:
             out += [
                 mk("veneur.samples.processed_total",
@@ -1201,7 +1258,12 @@ class Server:
         #     spill_evicted_total (budget/gauge-age eviction) is loss.
         for (dest, cname), v in sorted(
                 resilience.DEFAULT_REGISTRY.take().items()):
-            out.append(mk(f"veneur.resilience.{cname}_total", v,
+            # dotted counter names carry their own namespace (the
+            # import path's "forward.duplicates_dropped" /
+            # "import.rejected" land under veneur.<name>_total);
+            # plain names are the egress layer's veneur.resilience.*
+            prefix = "veneur." if "." in cname else "veneur.resilience."
+            out.append(mk(f"{prefix}{cname}_total", v,
                           MetricType.COUNTER, [f"destination:{dest}"]))
         if self._stats_sock is not None:
             # scopedstatsd mode: ship veneur.* over the wire to

@@ -13,13 +13,22 @@ forever once the script is exhausted):
     "timeout"         raise TimeoutError
     "refused"         raise ConnectionRefusedError
     "reset"           raise ConnectionResetError
+    "ack_lost"        the AMBIGUOUS failure: the receiver consumes and
+                      applies the request body (the transport's
+                      `deliver` callback runs / the callable's
+                      delivery side effects happen), then the response
+                      is dropped — raised as TimeoutError. The sender
+                      cannot distinguish this from "timeout"; only an
+                      idempotency envelope + receiver dedupe makes the
+                      inevitable retry/replay safe.
     503 (any int)     HTTP status: >=400 raises HTTPStatusError-shaped
                       failure via a fake response; <400 succeeds
     ("slow", dt)      advance the clock by dt seconds, then succeed
     ("slow", dt, s)   advance the clock by dt, then apply step `s`
 
 `seeded_schedule` derives a reproducible random schedule from a seed —
-the property-style way to exercise the retry ladder.
+the property-style way to exercise the retry ladder
+(`ambiguous=True` mixes ack-loss into the fault pool).
 """
 
 from __future__ import annotations
@@ -74,11 +83,17 @@ class _FakeResponse:
         return False
 
 
-def seeded_schedule(seed: int, n: int, p_fail: float = 0.5):
+def seeded_schedule(seed: int, n: int, p_fail: float = 0.5,
+                    ambiguous: bool = False):
     """Reproducible schedule of n steps ending in "ok" (so a bounded
-    retry ladder can always terminate in tests that want delivery)."""
+    retry ladder can always terminate in tests that want delivery).
+    `ambiguous=True` adds ack-loss (applied-then-dropped-response)
+    to the fault pool — only safe to deliver through a deduping
+    receiver."""
     rng = random.Random(seed)
     faults = ["timeout", "refused", 503, 500, ("slow", 0.05)]
+    if ambiguous:
+        faults = faults + ["ack_lost", "ack_lost"]
     steps = [rng.choice(faults) if rng.random() < p_fail else "ok"
              for _ in range(max(0, n - 1))]
     return steps + ["ok"]
@@ -88,11 +103,21 @@ class ScriptedTransport:
     """Scripted stand-in for the resilience layer's HTTP transport:
     `transport(req, timeout=None)` consumes one schedule step per call.
     Records every attempt as (monotonic_time, timeout, step, request)
-    in `.calls` for timeline assertions."""
+    in `.calls` for timeline assertions.
 
-    def __init__(self, schedule, clock: FakeClock | None = None):
+    `deliver` (optional) is the RECEIVER: a callable(req) invoked for
+    every step whose body reaches the other end — "ok", success
+    statuses, and "ack_lost" (which applies the body, then drops the
+    response). Wiring `deliver` to a real import endpoint turns the
+    transport into an end-to-end ambiguous-failure chaos harness: the
+    receiver's state advances while the sender sees a timeout. When
+    `deliver` returns a response-like object, "ok" returns it."""
+
+    def __init__(self, schedule, clock: FakeClock | None = None,
+                 deliver=None):
         self.schedule = list(schedule) or ["ok"]
         self.clock = clock or FakeClock()
+        self.deliver = deliver
         self.calls: list[tuple] = []
         self._lock = threading.Lock()
         self._i = 0
@@ -111,22 +136,32 @@ class ScriptedTransport:
     def __call__(self, req=None, timeout=None):
         step = self._next_step()
         self.calls.append((self.clock(), timeout, step, req))
-        return self._apply(step)
+        return self._apply(step, req)
 
-    def _apply(self, step):
+    def _deliver(self, req):
+        return self.deliver(req) if self.deliver is not None else None
+
+    def _apply(self, step, req=None):
         if isinstance(step, tuple) and step and step[0] == "slow":
             self.clock.advance(float(step[1]))
             inner = step[2] if len(step) > 2 else "ok"
-            return self._apply(inner)
+            return self._apply(inner, req)
+        if step == "ack_lost":
+            # the ambiguous failure: the body is consumed and APPLIED
+            # by the receiver, then the response never makes it back
+            self._deliver(req)
+            raise TimeoutError("scripted ack lost (body was applied)")
         if isinstance(step, int):
             if step >= 400:
                 # shaped like urllib: an error status raises, carrying
                 # the code — classified retryable iff 5xx/408/429
                 from ..resilience import HTTPStatusError
                 raise HTTPStatusError("scripted", step)
+            self._deliver(req)
             return _FakeResponse(step)
         if step == "ok":
-            return _FakeResponse(200)
+            resp = self._deliver(req)
+            return resp if resp is not None else _FakeResponse(200)
         if step == "timeout":
             raise TimeoutError("scripted timeout")
         if step == "refused":
@@ -152,6 +187,14 @@ class ScriptedCallable(ScriptedTransport):
     def __call__(self, *args, timeout=None, **kwargs):
         step = self._next_step()
         self.calls.append((self.clock(), timeout, step, args))
+        if step == "ack_lost":
+            # ambiguous failure for callables: the delivery side
+            # effects HAPPEN (recorded + on_success runs, e.g. a real
+            # gRPC send underneath), then the ack is dropped
+            self.delivered.append(args)
+            if self.on_success is not None:
+                self.on_success(*args, **kwargs)
+            raise TimeoutError("scripted ack lost (body was applied)")
         out = self._apply(step)          # raises on fault steps
         self.delivered.append(args)
         if self.on_success is not None:
@@ -170,8 +213,8 @@ class FaultHarness:
         from ..resilience import ResilienceRegistry
         self.registry = ResilienceRegistry()
 
-    def transport(self, schedule) -> ScriptedTransport:
-        return ScriptedTransport(schedule, self.clock)
+    def transport(self, schedule, deliver=None) -> ScriptedTransport:
+        return ScriptedTransport(schedule, self.clock, deliver=deliver)
 
     def callable(self, schedule, **kw) -> ScriptedCallable:
         return ScriptedCallable(schedule, self.clock, **kw)
