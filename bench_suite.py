@@ -661,11 +661,27 @@ def config6_e2e_udp_ingest(seconds: float = 8.0):
           platform=_platform())
 
 
+def _mesh_available() -> bool:
+    """The mesh engine needs the top-level jax.shard_map export; this
+    interpreter's jax only ships jax.experimental.shard_map (the same
+    environmental API drift tests/envprobes.py gates tier-1 on). An
+    explicit skip row beats a crash row: the artifact says WHY the
+    config is absent."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return True
+    _emit("mesh_env_skip_no_jax_shard_map", 1, "bool", None,
+          jax_version=jax.__version__)
+    return False
+
+
 def config5_multichip_100k():
     import jax
 
     from veneur_tpu.parallel.mesh import MeshEngine, make_mesh
 
+    if not _mesh_available():
+        return
     D = len(jax.devices())
     n_shard = D
     mesh = make_mesh(1, n_shard)
@@ -716,6 +732,9 @@ def config7_mesh_global_merge():
     from veneur_tpu.ingest.parser import MetricKey
     from veneur_tpu.models.pipeline import EngineConfig
     from veneur_tpu.parallel.engine import MeshAggregationEngine
+
+    if not _mesh_available():
+        return
 
     D = len(jax.devices())
     n_shards, keys, per = 32, 512, 128
@@ -975,12 +994,109 @@ def config8_ingest_stages():
                 if _platform() == "cpu" else "tpu dispatch path"))
 
 
+def config12_durability_journal():
+    """Durability journal-append overhead on the flush tick.
+
+    The write-ahead BEGIN record (one CRC32C pass over the serialized
+    interval + a buffered file append) is the only new flush-tick cost
+    when `durability_enabled: true`; DONE is a 13-byte frame and the
+    flush-boundary sync is one fsync. This config pins the per-tick
+    forward cost with the journal off vs on (fsync=interval, the
+    default, and fsync=always, the power-loss-proof mode) over a
+    representative interval: 256 histogram keys x 64 centroids, 64
+    HLL sets (p=12), 1024 counters, 256 gauges — ~1.6k sketches, the
+    shape of a busy local veneur's tick. `durability_enabled: false`
+    must measure as exactly the off column (the regression test in
+    tests/test_exactly_once_chaos.py pins the no-op; this row pins the
+    cost of turning it ON)."""
+    import shutil
+    import tempfile
+
+    from veneur_tpu.durability import ForwardJournal
+    from veneur_tpu.ingest.parser import MetricKey
+    from veneur_tpu.models.pipeline import ForwardExport
+    from veneur_tpu.resilience import (ResilienceRegistry,
+                                       ResilientForwarder)
+
+    rng = np.random.default_rng(3)
+
+    def mk_export():
+        exp = ForwardExport()
+        for k in range(256):
+            means = np.sort(rng.normal(100, 25, 64).astype(np.float32))
+            weights = rng.uniform(0.5, 4.0, 64).astype(np.float32)
+            exp.histograms.append(
+                (MetricKey(f"bench.h{k}", "timer", "env:prod,az:a"),
+                 means, weights, float(means.min()), float(means.max()),
+                 float((means * weights).sum()), float(weights.sum()),
+                 1.0))
+        for k in range(64):
+            exp.sets.append(
+                (MetricKey(f"bench.s{k}", "set", ""),
+                 rng.integers(0, 48, 1 << 12).astype(np.uint8)))
+        for k in range(1024):
+            exp.counters.append(
+                (MetricKey(f"bench.c{k}", "counter", ""),
+                 float(rng.uniform(0, 1e6))))
+        for k in range(256):
+            exp.gauges.append(
+                (MetricKey(f"bench.g{k}", "gauge", ""),
+                 float(rng.normal())))
+        return exp
+
+    export = mk_export()
+    inner = lambda export, envelope=None: None   # noqa: E731 — always ok
+    n_ticks = 30
+
+    def run(journal_dir, fsync):
+        journal = None
+        if journal_dir is not None:
+            journal = ForwardJournal(journal_dir, fsync=fsync)
+        fwd = ResilientForwarder(inner, destination="bench",
+                                 sender_id="bench", seq_start=1,
+                                 journal=journal,
+                                 registry=ResilienceRegistry())
+        fwd(export)                     # warm (lazy imports, caches)
+        fwd.journal_tick()
+        bytes_per_tick = 0
+        if journal is not None:         # one tick's BEGIN+DONE frames
+            before = journal.size_bytes()
+            fwd(export)
+            bytes_per_tick = journal.size_bytes() - before
+        times = []
+        for _ in range(n_ticks):
+            t0 = time.perf_counter()
+            fwd(export)
+            fwd.journal_tick()          # the server's flush-boundary hook
+            times.append(time.perf_counter() - t0)
+        if journal is not None:
+            journal.close()
+        return float(np.median(times) * 1e3), bytes_per_tick
+
+    off_ms, _ = run(None, None)
+    tmp = tempfile.mkdtemp(prefix="veneur-bench-journal-")
+    try:
+        interval_ms, tick_bytes = run(os.path.join(tmp, "i"), "interval")
+        always_ms, _ = run(os.path.join(tmp, "a"), "always")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _emit("c12_flush_tick_forward_ms_journal_off", off_ms, "ms", None)
+    _emit("c12_flush_tick_forward_ms_journal_interval", interval_ms,
+          "ms", None)
+    _emit("c12_flush_tick_forward_ms_journal_always", always_ms, "ms",
+          None)
+    _emit("c12_journal_append_overhead_ms", interval_ms - off_ms, "ms",
+          None, sketches_per_tick=256 + 64 + 1024 + 256)
+    _emit("c12_journal_bytes_per_tick", tick_bytes, "bytes", None)
+
+
 CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            3: config3_sets_1m_uniques, 4: config4_forward_merge_32_shards,
            5: config5_multichip_100k, 6: config6_e2e_udp_ingest,
            9: config5b_ssf_span_ingest, 10: config4b_multiseed_accuracy,
            11: config5c_ssf_native_span_ingest,
-           7: config7_mesh_global_merge, 8: config8_ingest_stages}
+           7: config7_mesh_global_merge, 8: config8_ingest_stages,
+           12: config12_durability_journal}
 
 
 def _run_isolated(configs: list[int], json_out: str) -> int:

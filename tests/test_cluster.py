@@ -8,6 +8,8 @@ import time
 import numpy as np
 import pytest
 
+from envprobes import needs_mesh_shard_map
+
 from veneur_tpu.cluster import wire
 from veneur_tpu.cluster.discovery import StaticDiscoverer
 from veneur_tpu.cluster.forward import GrpcForwarder
@@ -311,6 +313,7 @@ def test_http_proxy_front_distributes_consistently():
         proxy.stop()
 
 
+@needs_mesh_shard_map
 def test_two_servers_grpc_forward_to_mesh_global():
     """local Server --forwardrpc--> GLOBAL Server whose engine is
     sharded over the 8-device mesh: the full multi-chip global tier,

@@ -1,0 +1,582 @@
+"""On-disk format + recovery tests for the durability subsystem.
+
+Three layers, mirroring tests/test_wire_golden.py's contract style:
+
+  * golden bytes — the frame layout (length | crc32c | type | payload),
+    the file magics, and the typed record encodings are pinned to
+    hand-constructed constants, so any byte-level drift fails loudly
+    instead of silently orphaning existing journals;
+  * randomized roundtrip — export payloads (centroids, HLL registers,
+    exact counters, gauges) survive encode/decode bit-exactly;
+  * torn-write / bit-flip fuzz — recovery over corrupted journals NEVER
+    raises and NEVER invents records: what comes back is always a
+    bit-exact prefix of what was appended.
+"""
+
+import os
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from veneur_tpu.durability import (ForwardJournal, Journal,
+                                   WatermarkJournal, crc32c)
+from veneur_tpu.durability import records as drec
+from veneur_tpu.durability.journal import (HEADER_BYTES, MAGIC,
+                                           SNAP_MAGIC, decode_frames,
+                                           encode_frame)
+from veneur_tpu.ingest.parser import MetricKey
+from veneur_tpu.models.pipeline import ForwardExport
+from veneur_tpu.resilience import (ForwardEnvelope, PartialDeliveryError,
+                                   ResilienceRegistry, ResilientForwarder)
+from veneur_tpu.utils.faults import FakeClock, ScriptedCallable
+
+
+def mk_export(seed: int = 0, n_keys: int = 3) -> ForwardExport:
+    rng = np.random.default_rng(seed)
+    exp = ForwardExport()
+    for k in range(n_keys):
+        n = int(rng.integers(1, 40))
+        means = np.sort(rng.normal(100, 20, n).astype(np.float32))
+        weights = rng.uniform(0.5, 4.0, n).astype(np.float32)
+        exp.histograms.append(
+            (MetricKey(f"h{k}", "timer", "a:b"), means, weights,
+             float(means.min()), float(means.max()),
+             float((means * weights).sum()), float(weights.sum()),
+             float(rng.uniform(0, 2))))
+    regs = rng.integers(0, 40, 1 << 4).astype(np.uint8)
+    exp.sets.append((MetricKey(f"s{seed}", "set", ""), regs))
+    exp.counters.append((MetricKey("c", "counter", "x:y"),
+                         float(rng.uniform(0, 100))))
+    exp.gauges.append((MetricKey("g", "gauge", ""),
+                       float(rng.normal())))
+    return exp
+
+
+def assert_export_equal(a: ForwardExport, b: ForwardExport):
+    assert len(a.histograms) == len(b.histograms)
+    for ea, eb in zip(a.histograms, b.histograms):
+        assert ea[0] == eb[0]
+        np.testing.assert_array_equal(ea[1], eb[1])
+        np.testing.assert_array_equal(ea[2], eb[2])
+        assert tuple(float(x) for x in ea[3:]) == \
+            tuple(float(x) for x in eb[3:])
+    assert [k for k, _ in a.sets] == [k for k, _ in b.sets]
+    for (_, ra), (_, rb) in zip(a.sets, b.sets):
+        np.testing.assert_array_equal(np.asarray(ra), np.asarray(rb))
+    assert [(k, float(v)) for k, v in a.counters] == \
+        [(k, float(v)) for k, v in b.counters]
+    assert [(k, float(v)) for k, v in a.gauges] == \
+        [(k, float(v)) for k, v in b.gauges]
+
+
+# ----------------------------------------------------------- golden bytes
+
+class TestGoldenBytes:
+    def test_crc32c_check_value(self):
+        # the CRC-32C check value from RFC 3720 / every published
+        # Castagnoli test vector
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(b"") == 0
+
+    def test_frame_golden_bytes(self):
+        # u32 length (type byte + payload) | u32 crc32c | type | payload
+        frame = encode_frame(1, b"hi")
+        golden = (struct.pack("<I", 3)
+                  + struct.pack("<I", crc32c(b"\x01hi"))
+                  + b"\x01hi")
+        assert frame == golden
+        assert frame == bytes.fromhex("03000000149fd9c1016869")
+        recs, end, torn = decode_frames(frame)
+        assert recs == [(1, b"hi")] and end == len(frame) and not torn
+
+    def test_empty_payload_frame_golden_bytes(self):
+        assert encode_frame(9, b"") == bytes.fromhex("010000009d88cf2a09")
+
+    def test_file_magics_pinned(self):
+        assert MAGIC == b"VTPUJRN1"
+        assert SNAP_MAGIC == b"VTPUSNP1"
+
+    def test_journal_file_golden_bytes(self, tmp_path):
+        j = Journal(str(tmp_path), "g", fsync="never")
+        j.load()
+        j.append(1, b"hi")
+        j.append(9, b"")
+        j.close()
+        with open(j.journal_path, "rb") as f:
+            # magic | u64 generation 0 | frames
+            assert f.read() == MAGIC + bytes(8) + bytes.fromhex(
+                "03000000149fd9c1016869010000009d88cf2a09")
+
+    def test_meta_record_golden_bytes(self):
+        # u32 len | utf8 sender_id | u64 next_seq
+        assert drec.encode_meta("s1", 7) == bytes.fromhex(
+            "0200000073310700000000000000")
+        assert drec.decode_meta(drec.encode_meta("s1", 7)) == ("s1", 7)
+
+    def test_watermarks_record_golden_bytes(self):
+        # u32 count | (u32 len | utf8 sender | u64 seq)*
+        assert drec.encode_watermarks({"a": 5}) == bytes.fromhex(
+            "0100000001000000610500000000000000")
+        assert drec.decode_watermarks(
+            drec.encode_watermarks({"a": 5})) == {"a": 5}
+
+    def test_export_payload_reuses_the_wire_codec(self):
+        # the sketch body of an export payload IS a serialized
+        # forwardrpc.MetricList — the same bytes the forwarder puts on
+        # the wire — plus exact f64 counters appended
+        from veneur_tpu.cluster.protos import forward_pb2
+        exp = ForwardExport()
+        exp.counters.append((MetricKey("c", "counter", ""), 7.25))
+        data = drec.encode_export(exp)
+        (blob_len,) = struct.unpack_from("<I", data, 0)
+        blob = data[4:4 + blob_len]
+        ml = forward_pb2.MetricList.FromString(blob)
+        assert ml.metrics[0].counter.value == 7     # wire rounds...
+        (exact,) = struct.unpack_from("<d", data, 4 + blob_len)
+        assert exact == 7.25                        # ...journal doesn't
+        back, off = drec.decode_export(data)
+        assert off == len(data)
+        assert back.counters[0][1] == 7.25
+
+
+# ----------------------------------------------------- randomized roundtrip
+
+class TestRandomizedRoundtrip:
+    def test_export_payload_roundtrip(self):
+        for seed in range(25):
+            exp = mk_export(seed, n_keys=4)
+            back, off = drec.decode_export(drec.encode_export(exp))
+            assert off == len(drec.encode_export(exp))
+            assert_export_equal(exp, back)
+
+    def test_begin_record_roundtrip(self):
+        exp = mk_export(3)
+        payload = drec.encode_begin(42, 2, 5, 1, exp)
+        seq, off, cnt, age, back = drec.decode_begin(payload)
+        assert (seq, off, cnt, age) == (42, 2, 5, 1)
+        assert_export_equal(exp, back)
+
+    def test_journal_append_reload_roundtrip(self, tmp_path):
+        rng = random.Random(11)
+        j = Journal(str(tmp_path), "rt", fsync="never")
+        j.load()
+        written = []
+        for _ in range(200):
+            rec = (rng.randrange(1, 10),
+                   rng.randbytes(rng.randrange(0, 500)))
+            written.append(rec)
+            j.append(*rec)
+        j.close()
+        j2 = Journal(str(tmp_path), "rt", fsync="never")
+        _snap, recs = j2.load()
+        assert recs == written
+        j2.close()
+
+    def test_watermark_journal_merges_by_max(self, tmp_path):
+        w = WatermarkJournal(str(tmp_path), fsync="never")
+        assert w.load() == {}
+        w.record({"a": 5, "b": 2})
+        w.record({"a": 7})
+        w.record({"a": 3})              # regressions never recorded
+        w.close()
+        w2 = WatermarkJournal(str(tmp_path), fsync="never")
+        assert w2.load() == {"a": 7, "b": 2}
+        w2.close()
+
+
+# ------------------------------------------------- torn-write / flip fuzz
+
+class TestTornWriteFuzz:
+    def _written(self, tmp_path, n=40, seed=5):
+        rng = random.Random(seed)
+        j = Journal(str(tmp_path), "fz", fsync="never")
+        j.load()
+        written = []
+        for _ in range(n):
+            rec = (rng.randrange(1, 250),
+                   rng.randbytes(rng.randrange(0, 120)))
+            written.append(rec)
+            j.append(*rec)
+        j.close()
+        return j.journal_path, written
+
+    def test_truncation_never_raises_never_invents(self, tmp_path):
+        path, written = self._written(tmp_path)
+        blob = open(path, "rb").read()
+        for cut in range(len(blob)):
+            recs, _end, _torn = decode_frames(blob[:cut], HEADER_BYTES) \
+                if cut >= HEADER_BYTES else ([], 0, True)
+            # a truncated journal yields a bit-exact PREFIX, only
+            assert recs == written[:len(recs)]
+
+    def test_bit_flip_never_raises_never_invents(self, tmp_path):
+        path, written = self._written(tmp_path)
+        blob = bytearray(open(path, "rb").read())
+        rng = random.Random(99)
+        for _ in range(300):
+            i = rng.randrange(HEADER_BYTES, len(blob))
+            bit = 1 << rng.randrange(8)
+            blob[i] ^= bit
+            recs, _end, torn = decode_frames(bytes(blob), HEADER_BYTES)
+            # the flip either hit the already-truncated tail (no-op) or
+            # cut the scan earlier; either way: bit-exact prefix. The
+            # one theoretically-surviving case is a 2^-32 CRC collision.
+            assert recs == written[:len(recs)]
+            if len(recs) < len(written):
+                assert torn
+            blob[i] ^= bit              # restore for the next trial
+
+    def test_recovery_after_corruption_resumes_appending(self, tmp_path):
+        path, written = self._written(tmp_path, n=10)
+        with open(path, "r+b") as f:    # flip one byte mid-file
+            f.seek(HEADER_BYTES + 30)
+            b = f.read(1)
+            f.seek(HEADER_BYTES + 30)
+            f.write(bytes([b[0] ^ 0xFF]))
+        reg = ResilienceRegistry()
+        j = Journal(str(tmp_path), "fz", fsync="never", registry=reg)
+        _snap, recs = j.load()
+        assert recs == written[:len(recs)] and len(recs) < len(written)
+        assert reg.peek("durability", "durability.truncated_frames") == 1
+        j.append(77, b"fresh")
+        j.close()
+        j2 = Journal(str(tmp_path), "fz", fsync="never")
+        _snap, recs2 = j2.load()
+        assert recs2 == recs + [(77, b"fresh")]
+        j2.close()
+
+    def test_corrupt_snapshot_is_dropped_not_fatal(self, tmp_path):
+        j = Journal(str(tmp_path), "sn", fsync="never")
+        j.load()
+        j.append(1, b"a")
+        j.snapshot([(2, b"state")])
+        j.append(3, b"post")
+        j.close()
+        # corrupt the snapshot body
+        with open(j.snapshot_path, "r+b") as f:
+            f.seek(HEADER_BYTES + 9)
+            f.write(b"\xff")
+        reg = ResilienceRegistry()
+        j2 = Journal(str(tmp_path), "sn", fsync="never", registry=reg)
+        snap, recs = j2.load()
+        assert snap is None             # dropped whole, never raises
+        assert recs == [(3, b"post")]   # journal survives independently
+        assert reg.peek("durability", "durability.truncated_frames") == 1
+        j2.close()
+
+
+# -------------------------------------------------- snapshot + compaction
+
+class TestSnapshotCompaction:
+    def test_snapshot_then_truncate_roundtrip(self, tmp_path):
+        j = Journal(str(tmp_path), "c", fsync="never")
+        j.load()
+        for i in range(20):
+            j.append(1, bytes([i]))
+        j.snapshot([(2, b"full-state")])
+        assert j.size_bytes() == HEADER_BYTES   # compacted
+        j.append(3, b"tail")
+        j.close()
+        j2 = Journal(str(tmp_path), "c", fsync="never")
+        snap, recs = j2.load()
+        assert snap == [(2, b"full-state")]
+        assert recs == [(3, b"tail")]
+        j2.close()
+
+    def test_crash_between_rename_and_truncate_never_double_applies(
+            self, tmp_path):
+        """The compaction crash window: the new snapshot has landed
+        (rename durable) but the journal was not yet truncated. The
+        journal's records are ALREADY inside the snapshot — recovery
+        must drop them by generation, not replay them on top."""
+        j = Journal(str(tmp_path), "gw", fsync="never")
+        j.load()
+        for i in range(5):
+            j.append(1, bytes([i]))
+        pre_truncate = open(j.journal_path, "rb").read()
+        j.snapshot([(2, b"folded-state")])
+        j.close()
+        # crash simulation: restore the PRE-truncate journal next to
+        # the NEW snapshot
+        with open(j.journal_path, "wb") as f:
+            f.write(pre_truncate)
+        reg = ResilienceRegistry()
+        j2 = Journal(str(tmp_path), "gw", fsync="never", registry=reg)
+        snap, recs = j2.load()
+        assert snap == [(2, b"folded-state")]
+        assert recs == []          # stale ops dropped, not re-applied
+        assert reg.peek("durability",
+                        "durability.stale_journal_dropped") == 1
+        # and the restamped journal keeps working at the new generation
+        j2.append(3, b"fresh")
+        j2.close()
+        j3 = Journal(str(tmp_path), "gw", fsync="never")
+        snap3, recs3 = j3.load()
+        assert snap3 == [(2, b"folded-state")]
+        assert recs3 == [(3, b"fresh")]
+        j3.close()
+
+    def test_second_appender_rejected_until_lock_released(
+            self, tmp_path):
+        """Two live appenders on one journal corrupt each other; the
+        advisory flock makes the second one fail LOUDLY. A (simulated)
+        SIGKILL releases the lock like the kernel would."""
+        from veneur_tpu.utils.faults import kill_journal_lock
+        j = Journal(str(tmp_path), "lk", fsync="never")
+        j.load()
+        j.append(1, b"a")
+        with pytest.raises(RuntimeError, match="locked by a live"):
+            Journal(str(tmp_path), "lk", fsync="never")
+        kill_journal_lock(j)            # the process "dies"
+        j2 = Journal(str(tmp_path), "lk", fsync="never")
+        _snap, recs = j2.load()
+        assert recs == [(1, b"a")]      # appended bytes survived
+        j2.close()
+
+    def test_leftover_tmp_file_is_ignored(self, tmp_path):
+        j = Journal(str(tmp_path), "c", fsync="never")
+        j.load()
+        j.snapshot([(2, b"s1")])
+        j.close()
+        # simulate a crash mid-snapshot: a stale .tmp next to the real one
+        with open(j.snapshot_path + ".tmp", "wb") as f:
+            f.write(b"garbage half-written")
+        j2 = Journal(str(tmp_path), "c", fsync="never")
+        snap, _recs = j2.load()
+        assert snap == [(2, b"s1")]
+        j2.close()
+
+    def test_forward_journal_compaction_preserves_ladder(self, tmp_path):
+        clock = FakeClock()
+        reg = ResilienceRegistry()
+        inner = ScriptedCallable(["refused"], clock)
+        fj = ForwardJournal(str(tmp_path), fsync="never",
+                            snapshot_journal_bytes=4096)
+        fwd = ResilientForwarder(inner, destination="d", sender_id="sid",
+                                 seq_start=1, journal=fj, clock=clock,
+                                 registry=reg)
+        for seed in range(4):           # park 4 intervals
+            with pytest.raises(ConnectionRefusedError):
+                fwd(mk_export(seed))
+        fwd.journal_tick()              # big enough -> compacts
+        assert fj.size_bytes() == HEADER_BYTES
+        entries = [(e.seq, e.age) for e in fwd._entries]
+        fj.close()
+        fj2 = ForwardJournal(str(tmp_path), fsync="never")
+        fwd2 = ResilientForwarder(ScriptedCallable(["ok"], clock),
+                                  destination="d", sender_id="x",
+                                  seq_start=1, journal=fj2, clock=clock,
+                                  registry=ResilienceRegistry())
+        assert fwd2.sender_id == "sid"
+        assert [(e.seq, e.age) for e in fwd2._entries] == entries
+        for (ea, eb) in zip(fwd._entries, fwd2._entries):
+            assert_export_equal(ea.export, eb.export)
+        assert fwd2._next_seq == fwd._next_seq
+        fj2.close()
+
+
+# -------------------------------------------------- fsync policy plumbing
+
+class TestFsyncPolicy:
+    def test_policy_validated(self, tmp_path):
+        with pytest.raises(ValueError):
+            Journal(str(tmp_path), "x", fsync="sometimes")
+
+    def test_fsync_counts_by_policy(self, tmp_path, monkeypatch):
+        calls = []
+        real = os.fsync
+        monkeypatch.setattr(os, "fsync", lambda fd: calls.append(fd)
+                            or real(fd))
+        clock = FakeClock()
+        for policy, appends, expect in (
+                ("always", 3, 3), ("never", 3, 0)):
+            j = Journal(str(tmp_path), f"p_{policy}", fsync=policy,
+                        clock=clock)
+            j.load()
+            calls.clear()      # load() may fsync the fresh header
+            for i in range(appends):
+                j.append(1, b"x")
+            assert len(calls) == expect, policy
+            j.close()
+
+    def test_interval_policy_batches_fsyncs(self, tmp_path, monkeypatch):
+        calls = []
+        real = os.fsync
+        monkeypatch.setattr(os, "fsync", lambda fd: calls.append(fd)
+                            or real(fd))
+        clock = FakeClock()
+        j = Journal(str(tmp_path), "iv", fsync="interval",
+                    fsync_interval_s=1.0, clock=clock)
+        j.load()
+        calls.clear()          # load() fsyncs the fresh header
+        for _ in range(10):
+            j.append(1, b"x")
+        assert calls == []              # within the interval: none
+        clock.advance(1.5)
+        j.append(1, b"x")
+        assert len(calls) == 1          # interval elapsed -> one fsync
+        j.sync()
+        assert len(calls) == 2          # flush boundary forces one
+        j.close()
+
+
+# ------------------------------------------- forwarder recovery semantics
+
+class TestForwarderRecovery:
+    def _mk(self, tmp_path, schedule, clock=None, reg=None, **kw):
+        clock = clock or FakeClock()
+        reg = reg or ResilienceRegistry()
+        inner = ScriptedCallable(schedule, clock)
+        fj = ForwardJournal(str(tmp_path), fsync="never")
+        fwd = ResilientForwarder(inner, destination="d", sender_id="sid",
+                                 seq_start=1, journal=fj, clock=clock,
+                                 registry=reg, **kw)
+        return fwd, inner, fj, reg
+
+    def test_clean_delivery_leaves_nothing_to_recover(self, tmp_path):
+        fwd, _inner, fj, _ = self._mk(tmp_path, ["ok"])
+        fwd(mk_export(0))
+        fj.close()
+        fwd2, _i2, fj2, reg2 = self._mk(tmp_path, ["ok"])
+        assert fwd2._entries == [] and len(fwd2.spill) == 0
+        assert reg2.peek("d", "durability.recovered_intervals") == 0
+        assert fwd2._next_seq == 2      # seq space continues
+        fj2.close()
+
+    def test_crash_between_send_and_done_replays_and_dedupes(
+            self, tmp_path):
+        """The ambiguous crash window: delivery succeeded, the process
+        died before the DONE record. Recovery MUST replay (at-least-
+        once at this layer); the receiver's dedupe ledger is what makes
+        it exactly-once — prove the replay carries the ORIGINAL
+        envelope so the ledger can actually see it."""
+        from veneur_tpu.utils.faults import SimulatedKill
+        fwd, inner, fj, _ = self._mk(tmp_path, ["kill_after_send"])
+        with pytest.raises(SimulatedKill):
+            fwd(mk_export(0))
+        assert len(inner.delivered) == 1        # the body DID land
+        fj.close()
+        sent = []
+
+        class Rec:
+            def __call__(self, export, envelope=None):
+                sent.append(envelope)
+        clock = FakeClock()
+        fj2 = ForwardJournal(str(tmp_path), fsync="never")
+        reg2 = ResilienceRegistry()
+        fwd2 = ResilientForwarder(Rec(), destination="d", sender_id="x",
+                                  seq_start=1, journal=fj2, clock=clock,
+                                  registry=reg2)
+        assert reg2.peek("d", "durability.recovered_intervals") == 1
+        fwd2(ForwardExport())
+        assert [e.interval_seq for e in sent] == [1]
+        assert sent[0].sender_id == "sid"       # original identity
+        fj2.close()
+
+    def test_partial_tail_recovers_chunk_progress(self, tmp_path):
+        exp = mk_export(1)
+        tail = ForwardExport()
+        tail.gauges.extend(exp.gauges)
+
+        class PartialInner:
+            def __call__(self, export, envelope=None):
+                raise PartialDeliveryError(tail, TimeoutError("t"),
+                                           delivered_chunks=2,
+                                           chunk_count=3)
+        clock = FakeClock()
+        fj = ForwardJournal(str(tmp_path), fsync="never")
+        fwd = ResilientForwarder(PartialInner(), destination="d",
+                                 sender_id="sid", seq_start=1,
+                                 journal=fj, clock=clock,
+                                 registry=ResilienceRegistry())
+        with pytest.raises(PartialDeliveryError):
+            fwd(exp)
+        fj.close()
+        fj2 = ForwardJournal(str(tmp_path), fsync="never")
+        fwd2 = ResilientForwarder(ScriptedCallable(["ok"], clock),
+                                  destination="d", sender_id="x",
+                                  seq_start=1, journal=fj2, clock=clock,
+                                  registry=ResilienceRegistry())
+        (entry,) = fwd2._entries
+        assert (entry.seq, entry.chunk_offset, entry.chunk_count) == \
+            (1, 2, 3)
+        assert_export_equal(entry.export, tail)
+        fj2.close()
+
+    def test_demoted_spill_tier_recovers(self, tmp_path):
+        clock = FakeClock()
+        fwd, _inner, fj, reg = self._mk(
+            tmp_path, ["refused"], clock=clock, max_spill_intervals=2)
+        for seed in range(4):           # 4 parks through a 2-entry cap
+            with pytest.raises(ConnectionRefusedError):
+                fwd(mk_export(seed))
+        assert len(fwd._entries) == 2 and len(fwd.spill) > 0
+        pending = fwd.pending_spill
+        fj.close()
+        fwd2, _i2, fj2, reg2 = self._mk(
+            tmp_path, ["ok"], clock=clock, max_spill_intervals=2)
+        assert len(fwd2._entries) == 2
+        assert len(fwd2.spill) == len(fwd.spill)
+        assert fwd2.pending_spill == pending
+        assert reg2.peek("d", "durability.recovered_intervals") == 2
+        assert reg2.peek("d", "durability.recovered_sketches") == pending
+        fj2.close()
+
+    def test_max_admitted_excludes_partially_admitted_seqs(self):
+        """A partially-delivered seq must NOT become a durable
+        watermark: restoring it after a receiver restart would
+        permanently refuse the tail the sender is still replaying."""
+        from veneur_tpu.cluster.importsrv import DedupeLedger
+        ledger = DedupeLedger(registry=ResilienceRegistry())
+        assert ledger.admit("s", 1, 0, 1)        # complete
+        assert ledger.admit("s", 2, 0, 3)        # 2 of 3 chunks only
+        assert ledger.admit("s", 2, 1, 3)
+        assert ledger.max_admitted() == {"s": 1}
+        assert ledger.admit("s", 2, 2, 3)        # tail lands
+        assert ledger.max_admitted() == {"s": 2}
+
+    def test_journal_io_error_degrades_not_drops(self, tmp_path,
+                                                 monkeypatch):
+        """A failing journal (disk full, I/O error) must never cost an
+        interval: the forwarder degrades to unjournaled operation —
+        the pre-durability lossless contract — and counts the event."""
+        clock = FakeClock()
+        reg = ResilienceRegistry()
+        delivered = []
+
+        class Rec:
+            def __call__(self, export, envelope=None):
+                delivered.append(envelope)
+        fj = ForwardJournal(str(tmp_path), fsync="never")
+        fwd = ResilientForwarder(Rec(), destination="d", sender_id="sid",
+                                 seq_start=1, journal=fj, clock=clock,
+                                 registry=reg)
+
+        def boom(*a, **k):
+            raise OSError(28, "No space left on device")
+        monkeypatch.setattr(fj.journal, "append", boom)
+        fwd(mk_export(0))               # write-ahead fails -> degrade
+        assert len(delivered) == 1      # ...but the interval DELIVERED
+        assert fwd._journal is None     # journaling disabled, counted
+        assert reg.peek("d", "durability.journal_errors") == 1
+        fwd(mk_export(1))               # later ticks keep flowing
+        assert len(delivered) == 2
+
+    def test_disabled_journal_is_bit_identical_noop(self, tmp_path):
+        """durability off (journal=None) must leave the forwarder's
+        behavior AND the filesystem untouched."""
+        before = set(os.listdir(tmp_path))
+        clock = FakeClock()
+        inner = ScriptedCallable(["refused", "ok", "ok"], clock)
+        fwd = ResilientForwarder(inner, destination="d", sender_id="sid",
+                                 seq_start=1, clock=clock,
+                                 registry=ResilienceRegistry())
+        with pytest.raises(ConnectionRefusedError):
+            fwd(mk_export(0))
+        fwd(mk_export(1))
+        fwd.journal_tick()              # flush-boundary hook: no-op
+        assert fwd._entries == []
+        assert set(os.listdir(tmp_path)) == before
+        assert [c[2] for c in inner.calls] == ["refused", "ok", "ok"]

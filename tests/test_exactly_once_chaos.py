@@ -23,8 +23,19 @@ timestamps, and the egress clock/sleep/rng are all injected fakes.
 The forwarder replays failed intervals oldest-first and parks the
 current interval behind a failed replay, so the global tier Combines
 interval seqs strictly in order — which is what makes bit-identity
-achievable at all (t-digest merges are order-sensitive)."""
+achievable at all (t-digest merges are order-sensitive).
 
+The kill-restart section at the bottom extends the harness with the
+durability journal: the scripted "kill" fault step (a BaseException,
+like SIGKILL) stops the sender mid-replay-ladder, a SECOND sender
+incarnation recovers the ladder from the journal and resumes under
+the ORIGINAL envelopes, and the same bit-identity criterion must hold
+— plus a receiver-restart arm proving persisted watermarks refuse
+ancient replays, and a durability-off regression pinning the default
+as a no-op."""
+
+import json
+import os
 import random
 import socket
 import time
@@ -35,13 +46,16 @@ import pytest
 
 from veneur_tpu.cluster.forward import HttpJsonForwarder
 from veneur_tpu.cluster.importsrv import DedupeLedger
+from veneur_tpu.cluster.wire import envelope_headers
 from veneur_tpu.config import read_config
+from veneur_tpu.durability import ForwardJournal
 from veneur_tpu.resilience import (BreakerPolicy, Egress, EgressPolicy,
                                    ResilienceRegistry,
                                    ResilientForwarder, RetryPolicy)
 from veneur_tpu.server import Server
 from veneur_tpu.sinks.basic import CaptureMetricSink
 from veneur_tpu.utils.faults import (FakeClock, ScriptedTransport,
+                                     SimulatedKill, kill_journal_lock,
                                      seeded_schedule)
 
 _SERVER_YAML = """
@@ -199,3 +213,232 @@ def test_chaos_state_bit_identical_to_oracle():
     names = {n for n, _t, _ty, _v in faulty}
     assert any(n.endswith(".50percentile") for n in names)
     assert "chaos.uniq" in names and "chaos.total" in names
+
+
+# =====================================================================
+# Kill-restart chaos: the durability journal under a hard sender kill
+# mid-replay-ladder, and a receiver restart against ancient replays.
+# =====================================================================
+
+def _hard_kill_local(srv):
+    """Simulate SIGKILL for the journal's purposes: stop threads and
+    release the sockets so the test can proceed in-process, but run
+    NONE of the graceful-shutdown hooks — no journal sync/close, no
+    drain, no forwarder close. Everything the new incarnation knows, it
+    must learn from the journal files."""
+    srv._stop.set()
+    for s in srv._sockets + srv._listen_socks:
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def _run_with_kill(tmp_path, seed: int = 7):
+    """The crashing arm: same topology as _run, but the forwarder
+    journals to tmp_path, round 3's transport hard-kills the sender
+    mid-replay-ladder (one replay delivered, then SimulatedKill), and
+    rounds 4+ run in a SECOND incarnation recovered from the journal.
+
+    Round script (seq = round + 1 in both arms):
+      r0  ok
+      r1  ack_lost then timeouts — chunk 0 APPLIED at the global, the
+          interval parks anyway (the ambiguous failure)
+      r2  503s — r1's replay fails, r2 parks behind it
+      r3  ok, kill — r1 replays (global dedupes chunk 0), then the
+          process "dies" with [r2, r3] still parked
+      --- hard kill + restart from the journal ---
+      r4  ok — recovered ladder replays r2, r3 under their ORIGINAL
+          envelopes, then r4 ships
+      r5  ok
+    """
+    reg = ResilienceRegistry()
+    glob, _gsink = _mk_global(reg)
+    clock = FakeClock()
+    rt = _RoundTransport()
+
+    def mk_egress():
+        return Egress(
+            "chaos-global",
+            policy=EgressPolicy(
+                retry=RetryPolicy(max_attempts=3, base_backoff_s=0.001,
+                                  max_backoff_s=0.002, deadline_s=120.0),
+                breaker=BreakerPolicy(failure_threshold=10_000)),
+            transport=rt, clock=clock, sleep=clock.sleep,
+            rng=random.Random(42), registry=reg)
+
+    base = f"http://127.0.0.1:{glob.http_api.port}"
+
+    def deliver(req):
+        return urllib.request.urlopen(req, timeout=5)
+
+    def mk_sender(registry):
+        inner = HttpJsonForwarder(base, timeout_s=5.0, max_per_body=3,
+                                  egress=mk_egress())
+        journal = ForwardJournal(str(tmp_path), fsync="never")
+        fwd = ResilientForwarder(inner, destination="chaos-global",
+                                 sender_id="crash-sender", seq_start=1,
+                                 journal=journal, registry=registry)
+        return _mk_local(fwd), fwd
+
+    schedules = [
+        ["ok"],
+        ["ack_lost", "timeout", "timeout"],
+        [503, 503, 503],
+        ["ok", "kill"],
+        ["ok"],
+        ["ok"],
+    ]
+    rng = np.random.default_rng(seed)
+    local, fwd = mk_sender(reg)
+    reg2 = None
+    try:
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for r, schedule in enumerate(schedules):
+            rt.current = ScriptedTransport(schedule, clock,
+                                           deliver=deliver)
+            c.sendto(_round_lines(r, rng),
+                     ("127.0.0.1", local.bound_port()))
+            # each flush's self-metric drain resets the counter, so
+            # every round waits for ITS datagram: >= 1 again
+            deadline = time.time() + 10
+            while local.packets_received < 1 and time.time() < deadline:
+                time.sleep(0.005)
+            assert local.packets_received >= 1, "datagram lost"
+            assert local.drain(10.0)
+            if r == 3:
+                with pytest.raises(SimulatedKill):
+                    local.flush_once(timestamp=1000 + r)
+                # the kill left [r2, r3] parked in memory and r3's
+                # write-ahead BEGIN in the journal
+                assert len(fwd._entries) == 2
+                _hard_kill_local(local)
+                # a real SIGKILL releases the journal's process lock
+                # with the fd; the in-process simulation must too
+                kill_journal_lock(fwd._journal)
+                reg2 = ResilienceRegistry()
+                local, fwd = mk_sender(reg2)
+            else:
+                local.flush_once(timestamp=1000 + r)
+            clock.advance(10.0)
+        c.close()
+        assert glob.drain(10.0)
+        out = sorted(
+            (m.name, tuple(m.tags), str(m.type), m.value)
+            for m in glob.flush_once(timestamp=9999)
+            if not m.name.startswith("veneur."))
+        dups = reg.peek("import", "forward.duplicates_dropped")
+        recovered = reg2.peek("chaos-global",
+                              "durability.recovered_intervals")
+        pending = fwd.pending_spill
+    finally:
+        local.stop()
+        glob.stop()
+    return out, dups, recovered, pending
+
+
+def test_sender_kill_restart_bit_identical_to_oracle(tmp_path):
+    """THE durability acceptance criterion: a sender hard-killed
+    mid-replay-ladder recovers its ladder from the journal, resumes
+    under the ORIGINAL envelopes (so the receiver drops the chunk that
+    was ambiguously applied before the crash), and the global tier's
+    flushed t-digest/HLL/counter state ends bit-identical to a
+    zero-crash oracle, with recovered_intervals_total > 0."""
+    faulty, dups, recovered, pending = _run_with_kill(tmp_path)
+    oracle, oracle_dups, oracle_pending = _run([["ok"]] * 6)
+    assert pending == 0 and oracle_pending == 0
+    # the kill stranded THREE intervals: the two parked ones (r1's —
+    # mid-replay when the kill hit — and r2's) plus r3's write-ahead
+    assert recovered == 3
+    assert dups > 0                # receiver dedupe caught the replay
+    assert oracle_dups == 0
+    assert faulty == oracle        # bit-identical, no approx
+    names = {n for n, _t, _ty, _v in faulty}
+    assert any(n.endswith(".50percentile") for n in names)
+    assert "chaos.uniq" in names and "chaos.total" in names
+
+
+def _mk_durable_global(tmp_path):
+    cfg = read_config(text=_SERVER_YAML)
+    cfg.http_address = "127.0.0.1:0"
+    cfg.is_global = True
+    cfg.durability_enabled = True
+    cfg.durability_dir = str(tmp_path)
+    cfg.durability_fsync = "never"
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[])
+    srv.start()
+    return srv
+
+
+def _post_import(port: int, body: list, sender: str, seq: int,
+                 chunk: int = 0, count: int = 1) -> dict:
+    headers = {"Content-Type": "application/json",
+               "X-Veneur-Forward-Version": "jsonmetric-v1"}
+    headers.update(envelope_headers(sender, seq, chunk, count))
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/import",
+        data=json.dumps(body).encode(), headers=headers, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def test_receiver_kill_restart_drops_ancient_replays(tmp_path):
+    """Receiver-side durability: the per-sender watermarks journaled at
+    each flush boundary survive a hard kill, so a restarted global
+    refuses a replay of an interval it already flushed downstream
+    (the pre-durability behavior was to re-admit and double-count)."""
+    body = [{"name": "wm.c", "type": "counter", "tags": [], "value": 3}]
+    glob = _mk_durable_global(tmp_path)
+    try:
+        port = glob.http_api.port
+        assert _post_import(port, body, "anc", 5) == {"imported": 1}
+        assert glob.drain(10.0)
+        # watermarks journal ONE TICK BEHIND (a mid-tick admission may
+        # not be in this tick's flushed state): tick 1 captures the
+        # snapshot, tick 2 makes it durable
+        glob.flush_once(timestamp=1)
+        glob.flush_once(timestamp=2)
+    finally:
+        # hard kill: listeners down, NO graceful journal close (only
+        # the process lock drops, as a real SIGKILL would drop it)
+        glob._stop.set()
+        glob.http_api.stop()
+        kill_journal_lock(glob._dedupe_journal)
+        for s in glob._sockets + glob._listen_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+    glob2 = _mk_durable_global(tmp_path)
+    try:
+        port2 = glob2.http_api.port
+        # the ancient replay (<= restored watermark) must dedupe...
+        assert _post_import(port2, body, "anc", 5) == \
+            {"imported": 0, "deduped": True}
+        # ...while genuinely new intervals flow
+        assert _post_import(port2, body, "anc", 6) == {"imported": 1}
+    finally:
+        glob2.stop()
+
+
+def test_durability_disabled_default_is_inert(tmp_path, monkeypatch):
+    """With durability off (the default config) the server builds no
+    journals, the flush tick does zero journal work, and nothing
+    touches the filesystem — the pre-durability behavior, regression-
+    pinned."""
+    monkeypatch.chdir(tmp_path)        # catch any stray relative writes
+    cfg = read_config(text=_SERVER_YAML)
+    cfg.forward_address = "placeholder:1"
+    sent = []
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                 forwarder=lambda export: sent.append(export))
+    try:
+        assert srv._forward_journal is None
+        assert srv._dedupe_journal is None
+        assert isinstance(srv.forwarder, ResilientForwarder)
+        assert srv.forwarder._journal is None
+        srv.start()
+        srv.flush_once(timestamp=1)
+        assert os.listdir(tmp_path) == []
+    finally:
+        srv.stop()

@@ -14,6 +14,7 @@ import time
 import numpy as np
 import pytest
 
+from envprobes import needs_mesh_shard_map
 from veneur_tpu.config import Config
 from veneur_tpu.ingest.parser import MetricKey
 from veneur_tpu.models.pipeline import EngineConfig
@@ -22,6 +23,7 @@ from veneur_tpu.server import Server
 from veneur_tpu.sinks.basic import CaptureMetricSink
 
 
+@needs_mesh_shard_map
 def test_mesh_engine_unit_all_types():
     """Direct engine test across every bank type and many slots, so
     samples land on every shard column."""
@@ -58,6 +60,7 @@ def test_mesh_engine_unit_all_types():
     assert len(eng.flush(timestamp=8).metrics) == 0
 
 
+@needs_mesh_shard_map
 def test_mesh_server_end_to_end_udp():
     cap = CaptureMetricSink()
     cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
@@ -105,6 +108,7 @@ def test_mesh_engine_rejects_forwarding():
                               n_devices=8)
 
 
+@needs_mesh_shard_map
 def test_mesh_hot_slot_batch():
     """A batch overfilling one slot's buffer takes the host pre-cluster
     sidestep on the mesh path too: exact count/sum/min/max, tail
@@ -135,6 +139,7 @@ def test_mesh_hot_slot_batch():
     assert by["cold.count"] == float((slots == cold).sum())
 
 
+@needs_mesh_shard_map
 def test_mesh_global_tier_imports():
     """The mesh engine as GLOBAL tier: 32 shards' forwarded digests,
     sets, counters and gauges Combine over the 8-device mesh and flush
@@ -193,6 +198,7 @@ def test_mesh_global_tier_imports():
     assert abs(by["u"] - 160) / 160 < 0.1
 
 
+@needs_mesh_shard_map
 def test_mesh_global_tier_adversarial_landing():
     """The global tier's exact-stats delta correction (engine.py
     host-replicates the device's f32 per-term arithmetic so the deltas
@@ -260,6 +266,7 @@ def test_mesh_global_tier_adversarial_landing():
             assert abs(got - exp) / exp < 0.02, (k, q, got, exp)
 
 
+@needs_mesh_shard_map
 @pytest.mark.parametrize("mode", ["staged", "async"])
 def test_mesh_flush_fetch_modes(mode):
     """Mesh flush under non-sync fetch modes matches sync results (the

@@ -8,6 +8,8 @@ paths are interchangeable on every platform.
 import numpy as np
 import pytest
 
+from envprobes import needs_mesh_shard_map
+
 from veneur_tpu.ops import hll
 from veneur_tpu.ops.pallas_hll import hll_stats
 
@@ -57,6 +59,7 @@ def test_estimate_via_pallas_stats_matches_jnp_estimate():
     assert float(est_pallas[3]) == 0.0   # empty slot stays 0
 
 
+@needs_mesh_shard_map
 def test_pallas_stats_inside_shard_map():
     """The mesh flush places the Pallas kernel INSIDE shard_map (device-
     local block compute after the dp register union). Validate the

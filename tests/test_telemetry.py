@@ -209,6 +209,70 @@ def test_sentry_client(http_capture):
     assert exc["stacktrace"]["frames"]
 
 
+def test_durability_self_metrics_flow_through_telemetry(tmp_path):
+    """veneur.durability.* self-metrics ride the existing telemetry
+    path: journal appends / recovered intervals drain from the
+    resilience registry as counters, journal_bytes and
+    snapshot_duration_ns report as gauges — all inside the normal
+    flush, no new plumbing."""
+    from veneur_tpu import resilience
+    from veneur_tpu.config import read_config
+
+    cap = CaptureMetricSink()
+    cfg = read_config(text=f"""
+interval: "3600s"
+hostname: h
+statsd_listen_addresses: ["udp://127.0.0.1:0"]
+forward_address: "placeholder:1"
+durability_enabled: true
+durability_dir: "{tmp_path}"
+durability_fsync: "never"
+tpu_histogram_slots: 256
+tpu_counter_slots: 128
+tpu_gauge_slots: 128
+tpu_set_slots: 64
+""")
+    resilience.DEFAULT_REGISTRY.take()   # isolate from other tests
+    sent = []
+    srv = Server(cfg, sinks=[cap], plugins=[], span_sinks=[],
+                 forwarder=lambda export: sent.append(export))
+    # the explicit forwarder got wrapped AND journaled
+    assert isinstance(srv.forwarder, resilience.ResilientForwarder)
+    assert srv.forwarder._journal is not None
+    srv.start()
+    try:
+        port = srv.bound_port()
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(b"dur.c:1|c|#veneurglobalonly", ("127.0.0.1", port))
+        deadline = time.monotonic() + 5
+        while srv.packets_received < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.drain(5)
+        srv.flush_once(timestamp=1)     # forwards -> journal appends
+        cap.wait_for_flush(1)
+        assert sent, "forward did not run"
+        # the registry drains at frameset-build time, BEFORE the
+        # forward runs — tick 1's BEGIN/DONE appends report in tick 2
+        srv.flush_once(timestamp=2)
+        cap.wait_for_flush(2)
+        by_name = {}
+        for m in cap.flushes[0] + cap.flushes[1]:
+            by_name.setdefault(m.name, [])
+            by_name[m.name].append(m)
+        from veneur_tpu.metrics import MetricType
+        appends = by_name["veneur.durability.journal_appends_total"]
+        # construction META (tick-1 report) + tick 1's BEGIN and DONE
+        # (tick-2 report)
+        assert sum(m.value for m in appends) >= 3
+        assert all(m.type == MetricType.COUNTER for m in appends)
+        jb = by_name["veneur.durability.journal_bytes"][0]
+        assert jb.type == MetricType.GAUGE
+        assert jb.value > 0             # magic + frames on disk
+        assert "veneur.durability.snapshot_duration_ns" in by_name
+    finally:
+        srv.stop()
+
+
 def test_multi_engine_flush_overlaps():
     """Engines flush concurrently: on the tunneled TPU backend each
     engine's device_get pays a ~65-90ms wire floor, so N sequential

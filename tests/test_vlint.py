@@ -62,6 +62,30 @@ def test_rs01_allows_the_resilience_layer_itself():
     assert [v for v in run_paths([path]) if v.rule == "RS01"] == []
 
 
+def test_dr01_raw_writes_in_durability_scope():
+    # open('wb'), write-flag os.open, os.write, Path.write_bytes, and
+    # the statically-opaque variable mode — exact lines; the rb read,
+    # the O_RDONLY os.open, and the suppressed write must all stay
+    # silent
+    assert lint("dr01_bad.py") == [("DR01", 10), ("DR01", 15),
+                                   ("DR01", 16), ("DR01", 21),
+                                   ("DR01", 44)]
+
+
+def test_dr01_allows_the_journal_module_itself():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(repo, "veneur_tpu", "durability")
+    assert [v for v in run_paths([pkg]) if v.rule == "DR01"] == []
+
+
+def test_dr01_out_of_scope_modules_unchecked():
+    # raw writes OUTSIDE the durability scope (e.g. the localfile
+    # plugin) are not DR01's business
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "sinks", "basic.py")
+    assert [v for v in run_paths([path]) if v.rule == "DR01"] == []
+
+
 def test_sr02_tdigest_bank_writes_outside_owner():
     # the construction (line 9), the _replace(weight=...) (line 20) and
     # the statically-opaque **kwargs forms (lines 34/38) are flagged;

@@ -40,4 +40,15 @@ DEFAULT_CONFIG = {
     "sr02_allow": (
         "veneur_tpu/ops/tdigest.py",
     ),
+    # DR01: where the durable-state write discipline applies (path
+    # substring match; the /dr01_ entry scopes the check's own test
+    # fixtures in) and the one module allowed raw file writes — the
+    # journal owns the CRC32C framing / fsync / atomic-rename contract.
+    "dr01_scope": (
+        "veneur_tpu/durability/",
+        "/dr01_",
+    ),
+    "dr01_allow": (
+        "veneur_tpu/durability/journal.py",
+    ),
 }

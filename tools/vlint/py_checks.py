@@ -1,4 +1,4 @@
-"""Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02.
+"""Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02, DR01.
 
 All checks are intentionally conservative: they resolve only what can
 be resolved statically within the project (local jit wrappers, module
@@ -723,6 +723,106 @@ def check_sr02(mod: PyModule, config: dict) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------------------- DR01
+
+_DR01_WRITE_MODE_CHARS = set("wax+")
+_DR01_PATH_WRITERS = ("write_bytes", "write_text")
+
+
+def check_dr01(mod: PyModule, config: dict) -> list[Violation]:
+    """Durable-state write discipline: every on-disk mutation inside
+    the durability package must go through the Journal append/snapshot
+    API (`dr01_allow` names the one module that owns the raw file I/O —
+    the framing/fsync/atomic-rename contract lives there). A stray
+    `open(..., 'w')`, `os.open`, `os.write`, or `Path.write_*` anywhere
+    else under `dr01_scope` could write un-CRC'd, un-framed, or
+    non-atomically-renamed bytes into the recovery path, silently
+    breaking the torn-write tolerance recovery depends on. Reads are
+    fine; intentional raw writes suppress with a reason."""
+    if not any(m in mod.path for m in config["dr01_scope"]):
+        return []
+    if any(mod.path.endswith(a) for a in config["dr01_allow"]):
+        return []
+    out = []
+
+    _OPAQUE = object()
+
+    def _mode_of(call: ast.Call):
+        """The open() mode: its literal value, None when omitted (the
+        read-only default), or _OPAQUE when present but not statically
+        resolvable — which is flagged like the os.open branch flags
+        unresolvable flags (a gate must not be dodgeable by spelling)."""
+        node = call.args[1] if len(call.args) >= 2 else None
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                node = kw.value
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        return _OPAQUE
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        leaf = (d.rsplit(".", 1)[-1] if d is not None
+                else getattr(node.func, "attr", None))
+        if d in ("open", "io.open", "builtins.open"):
+            mode = _mode_of(node)
+            if mode is _OPAQUE or (isinstance(mode, str) and (
+                    _DR01_WRITE_MODE_CHARS & set(mode))):
+                shown = "<unresolvable>" if mode is _OPAQUE else repr(mode)
+                out.append(Violation(
+                    mod.path, node.lineno, "DR01",
+                    f"open(..., {shown}) writes durable state outside "
+                    "the journal/snapshot API — route the bytes through "
+                    "Journal.append/snapshot (CRC32C framing, fsync "
+                    "policy, atomic rename) or suppress with a reason"))
+        elif d == "os.open":
+            # reads are unrestricted: flag only when the flags
+            # expression names a write-capable O_* constant, or when
+            # it is statically opaque (a gate must not be dodgeable
+            # by an unresolvable spelling)
+            flags_node = None
+            if len(node.args) >= 2:
+                flags_node = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "flags":
+                        flags_node = kw.value
+            names = {n.attr for n in ast.walk(flags_node)
+                     if isinstance(n, ast.Attribute)} \
+                if flags_node is not None else set()
+            write_flags = names & {"O_WRONLY", "O_RDWR", "O_CREAT",
+                                   "O_APPEND", "O_TRUNC", "O_EXCL",
+                                   "O_TMPFILE"}
+            readonly = names and not write_flags and all(
+                n.startswith("O_") for n in names)
+            if not readonly:
+                out.append(Violation(
+                    mod.path, node.lineno, "DR01",
+                    "os.open() with write-capable (or unresolvable) "
+                    "flags under the durability package bypasses the "
+                    "journal/snapshot API's framing and fsync "
+                    "discipline — use Journal.append/snapshot or "
+                    "suppress with a reason"))
+        elif d == "os.write":
+            out.append(Violation(
+                mod.path, node.lineno, "DR01",
+                "os.write() under the durability package writes "
+                "unframed bytes the recovery scan cannot validate — "
+                "use Journal.append/snapshot or suppress with a reason"))
+        elif leaf in _DR01_PATH_WRITERS and isinstance(
+                node.func, ast.Attribute):
+            out.append(Violation(
+                mod.path, node.lineno, "DR01",
+                f".{leaf}() under the durability package bypasses the "
+                "journal/snapshot API — use Journal.append/snapshot or "
+                "suppress with a reason"))
+    return out
+
+
 # ------------------------------------------------------------------- driver
 
 def check_module(mod: PyModule, ctx: Context, config: dict
@@ -735,4 +835,5 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_cf01(mod, ctx, config))
     out.extend(check_rs01(mod, config))
     out.extend(check_sr02(mod, config))
+    out.extend(check_dr01(mod, config))
     return out

@@ -51,7 +51,8 @@ class _SenderState:
 
     def __init__(self, now: float):
         self.watermark = 0          # every seq <= watermark is a dup
-        self.seqs: OrderedDict = OrderedDict()   # seq -> set(chunk_idx)
+        # seq -> [set(chunk_idx), expected_chunk_count (0 = unknown)]
+        self.seqs: OrderedDict = OrderedDict()
         self.last_seen = now
 
 
@@ -118,7 +119,7 @@ class DedupeLedger:
     def _forget_sender(self, sender_id: str):
         st = self._senders.pop(sender_id, None)
         if st is not None:
-            self._size -= sum(len(s) for s in st.seqs.values())
+            self._size -= sum(len(s[0]) for s in st.seqs.values())
 
     def admit(self, sender_id: str, seq: int, chunk_index: int,
               chunk_count: int = 0) -> bool:
@@ -142,15 +143,22 @@ class DedupeLedger:
                 st.last_seen = now
             if seq <= st.watermark:
                 return self._drop()
-            chunks = st.seqs.get(seq)
-            if chunks is None:
-                chunks = st.seqs[seq] = set()
+            entry = st.seqs.get(seq)
+            if entry is None:
+                entry = st.seqs[seq] = [set(), int(chunk_count or 0)]
                 while len(st.seqs) > self.max_seqs_per_sender:
                     evicted_seq, evicted = st.seqs.popitem(last=False)
                     st.watermark = max(st.watermark, evicted_seq)
-                    self._size -= len(evicted)
-            elif chunk_index in chunks:
+                    self._size -= len(evicted[0])
+            elif chunk_index in entry[0]:
                 return self._drop()
+            if chunk_count:
+                # a replayed tail carries the ORIGINAL total; keep the
+                # freshest nonzero claim (completeness feeds
+                # max_admitted — partial seqs must not become durable
+                # watermarks)
+                entry[1] = int(chunk_count)
+            chunks = entry[0]
             if len(chunks) >= self.MAX_CHUNKS_PER_SEQ:
                 # abuse guard: evict the bloated seq wholesale and
                 # reject the overflow chunk, keeping memory bounded
@@ -163,6 +171,49 @@ class DedupeLedger:
             chunks.add(chunk_index)
             self._size += 1
             return True
+
+    def max_admitted(self) -> dict:
+        """Per-sender max COMPLETELY-admitted interval_seq (the
+        watermark plus any tracked seq whose every chunk arrived). The
+        server journals this at each flush boundary
+        (durability.WatermarkJournal) so a restarted global can refuse
+        ancient replays of intervals it already flushed downstream
+        before the crash. Partially-admitted seqs are excluded: making
+        one a durable watermark would permanently refuse the
+        undelivered tail the sender is still replaying. A seq with an
+        unknown total (chunk_count 0 — single-chunk/legacy stamping)
+        counts as complete on first admission."""
+        out = {}
+        with self._lock:
+            for sid, st in self._senders.items():
+                mark = st.watermark
+                for seq, (chunks, expected) in st.seqs.items():
+                    if len(chunks) >= expected:
+                        mark = max(mark, seq)
+                out[sid] = mark
+        return out
+
+    def restore_watermarks(self, marks: dict) -> int:
+        """Recovery-before-listen: seed per-sender watermarks from the
+        durable journal. Every restored seq becomes a hard floor —
+        seq <= watermark is dropped — so a replay of a pre-crash
+        interval cannot double-count downstream. Chunk sets are NOT
+        restored (they died with the engine state they guarded; a
+        replay of a NOT-yet-flushed interval re-admits and re-applies,
+        which is correct because its first application was lost with
+        the crash). Returns the number of senders restored."""
+        n = 0
+        with self._lock:
+            now = self._clock()
+            for sender_id, seq in marks.items():
+                st = self._senders.get(sender_id)
+                if st is None:
+                    if len(self._senders) >= self.max_senders:
+                        self._forget_sender(next(iter(self._senders)))
+                    st = self._senders[sender_id] = _SenderState(now)
+                st.watermark = max(st.watermark, int(seq))
+                n += 1
+        return n
 
     def size(self) -> int:
         """Tracked chunk entries across all senders (the

@@ -163,6 +163,34 @@ def export_to_metrics(export: ForwardExport) -> list:
     return out
 
 
+def export_from_metrics(metrics) -> ForwardExport:
+    """[metricpb.Metric] -> ForwardExport — the exact inverse of
+    export_to_metrics over its image (entry order preserved per type,
+    so the concatenated wire order survives a roundtrip and replayed
+    chunk indices keep lining up). Counter values come back as the
+    wire's int64; callers that need exact floats (the durability
+    journal) carry them in a side channel."""
+    export = ForwardExport()
+    for m in metrics:
+        key = metric_key_of(m)
+        which = m.WhichOneof("value")
+        if which == "histogram":
+            td = m.histogram.t_digest
+            means = np.array([c.mean for c in td.centroids], np.float32)
+            weights = np.array([c.weight for c in td.centroids],
+                               np.float32)
+            export.histograms.append(
+                (key, means, weights, td.min, td.max, td.sum, td.count,
+                 td.reciprocal_sum))
+        elif which == "set":
+            export.sets.append((key, decode_hll(m.set.hyper_log_log)))
+        elif which == "counter":
+            export.counters.append((key, float(m.counter.value)))
+        elif which == "gauge":
+            export.gauges.append((key, float(m.gauge.value)))
+    return export
+
+
 def metric_key_of(m) -> MetricKey:
     mtype = _PB_TO_TYPE.get(m.type, "histogram")
     return MetricKey(name=m.name, type=mtype,

@@ -104,6 +104,28 @@ class Config:
     forward_dedupe_max_senders: int = 1024
     forward_dedupe_ttl: str = "1h"   # idle senders forgotten after this
 
+    # --- durable state (veneur_tpu/durability/) ---
+    # Off by default: with durability disabled the flush path does zero
+    # journal work and behavior is identical to the pre-durability tree
+    # (regression-tested). When on, the sender's replay ladder + spill
+    # tier and the receiver's dedupe watermarks survive a hard kill:
+    # recovery runs before any listener binds, parked intervals replay
+    # under their ORIGINAL envelopes, and a restarted global refuses
+    # ancient replays it already flushed downstream.
+    durability_enabled: bool = False
+    durability_dir: str = "veneur-durability"
+    # fsync policy: "always" (fsync per append — power-loss-proof,
+    # slowest), "interval" (fsync at most once per
+    # durability_fsync_interval plus every flush boundary — the
+    # default; a process kill still loses nothing, only power loss can
+    # cost up to one interval of records), "never" (leave syncing to
+    # the kernel).
+    durability_fsync: str = "interval"
+    durability_fsync_interval: str = "1s"
+    # snapshot+compact a journal once it outgrows this many bytes
+    # (checked at flush boundaries; atomic write-temp/fsync/rename)
+    durability_snapshot_journal_bytes: int = 1 << 22
+
     # --- TLS (statsd/SSF stream listeners) ---
     tls_key: str = ""
     tls_certificate: str = ""
@@ -250,9 +272,21 @@ def _validate(cfg: Config) -> None:
             "it); typical deployments use 3-4", len(cfg.percentiles))
     if cfg.interval_seconds <= 0:
         raise ValueError(f"interval must be positive: {cfg.interval!r}")
+    if cfg.durability_fsync not in ("always", "interval", "never"):
+        raise ValueError(
+            "durability_fsync must be one of always/interval/never, "
+            f"got {cfg.durability_fsync!r}")
+    if cfg.durability_enabled and not cfg.durability_dir:
+        raise ValueError(
+            "durability_enabled requires a durability_dir")
+    if cfg.durability_snapshot_journal_bytes < 4096:
+        raise ValueError(
+            "durability_snapshot_journal_bytes must be >= 4096 "
+            "(a snapshot cycle per append would thrash the disk)")
     for key in ("flush_timeout", "retry_backoff_base",
                 "retry_backoff_cap", "retry_deadline",
-                "breaker_open_duration", "forward_dedupe_ttl"):
+                "breaker_open_duration", "forward_dedupe_ttl",
+                "durability_fsync_interval"):
         if _parse_interval(getattr(cfg, key)) <= 0:
             raise ValueError(
                 f"{key} must be a positive duration: "

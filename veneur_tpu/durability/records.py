@@ -1,0 +1,228 @@
+"""The typed record layer over the journal framing.
+
+Sketch payloads reuse the cluster wire codecs (`cluster/wire.py`): a
+parked interval's `ForwardExport` serializes as a `forwardrpc.
+MetricList` — the exact bytes the forwarder would put on the wire —
+plus a side channel of exact f64 counter values (the wire rounds
+counters to int64; the journal must hand back exactly what was parked
+so a recovered replay is bit-identical to the send the crash
+interrupted). t-digest centroids, HLL registers, gauges, and the
+min/max/sum/count/reciprocal_sum scalars are all lossless in the
+MetricList itself.
+
+Record kinds (sender-side "forward" journal — an op log whose replay
+reconstructs the `ResilientForwarder` ladder + spill tier exactly):
+
+    META         sender_id + next interval_seq (identity; a recovered
+                 sender MUST resume under its original sender_id or the
+                 receiver's dedupe ledger cannot see its replays)
+    BEGIN        a send attempt entered the ladder: seq, chunk
+                 progress, age, and the full export payload (write-
+                 ahead: appended BEFORE any wire traffic, so a crash
+                 mid-ladder cannot lose the interval)
+    DONE         seq delivered — the entry leaves the ladder
+    UPDATE       partial delivery / spill re-merge changed an entry's
+                 export or chunk progress
+    AGE          one failed-flush aging pass over the ladder
+    DEMOTE       the oldest entry overflowed into the merged spill tier
+    SPILL_MERGE  the spill tier drained into the current interval
+    SPILL_STATE  full spill-tier contents (snapshot compaction only)
+
+Receiver-side "dedupe" journal:
+
+    WATERMARKS   the per-sender max admitted interval_seq at a flush
+                 boundary — a restarted global restores these so an
+                 ancient replay (already flushed downstream before the
+                 crash) is dropped, not re-admitted
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..models.pipeline import ForwardExport
+
+REC_META = 1
+REC_BEGIN = 2
+REC_DONE = 3
+REC_UPDATE = 4
+REC_AGE = 5
+REC_DEMOTE = 6
+REC_SPILL_MERGE = 7
+REC_SPILL_STATE = 8
+REC_WATERMARKS = 9
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_F64 = struct.Struct("<d")
+_BEGIN_HEAD = struct.Struct("<QIII")    # seq, chunk_offset, chunk_count, age
+_UPDATE_HEAD = struct.Struct("<QII")    # seq, chunk_offset, chunk_count
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return _U32.pack(len(b)) + b
+
+
+def _unpack_str(data: bytes, off: int):
+    (n,) = _U32.unpack_from(data, off)
+    off += _U32.size
+    return data[off:off + n].decode("utf-8"), off + n
+
+
+# ------------------------------------------------------------- exports
+
+def encode_export(export: ForwardExport) -> bytes:
+    """ForwardExport -> MetricList bytes + exact f64 counter values."""
+    from ..cluster import wire
+    from ..cluster.protos import forward_pb2
+    blob = forward_pb2.MetricList(
+        metrics=wire.export_to_metrics(export)).SerializeToString()
+    exact = b"".join(_F64.pack(float(v)) for _k, v in export.counters)
+    return _U32.pack(len(blob)) + blob + exact
+
+
+def decode_export(data: bytes, off: int = 0):
+    """-> (ForwardExport, next_offset). Inverse of encode_export; the
+    exact counter side channel overwrites the wire's int64 rounding."""
+    from ..cluster import wire
+    from ..cluster.protos import forward_pb2
+    (n,) = _U32.unpack_from(data, off)
+    off += _U32.size
+    ml = forward_pb2.MetricList.FromString(data[off:off + n])
+    off += n
+    export = wire.export_from_metrics(ml.metrics)
+    for i in range(len(export.counters)):
+        key, _v = export.counters[i]
+        (v,) = _F64.unpack_from(data, off)
+        off += _F64.size
+        export.counters[i] = (key, v)
+    return export, off
+
+
+# ------------------------------------------------- sender (forward) ops
+
+def encode_meta(sender_id: str, next_seq: int) -> bytes:
+    return _pack_str(sender_id) + _U64.pack(next_seq)
+
+
+def decode_meta(data: bytes):
+    sender_id, off = _unpack_str(data, 0)
+    (next_seq,) = _U64.unpack_from(data, off)
+    return sender_id, next_seq
+
+
+def encode_begin(seq: int, chunk_offset: int, chunk_count: int,
+                 age: int, export: ForwardExport) -> bytes:
+    return _BEGIN_HEAD.pack(seq, chunk_offset, chunk_count, age) \
+        + encode_export(export)
+
+
+def decode_begin(data: bytes):
+    seq, chunk_offset, chunk_count, age = _BEGIN_HEAD.unpack_from(data, 0)
+    export, _ = decode_export(data, _BEGIN_HEAD.size)
+    return seq, chunk_offset, chunk_count, age, export
+
+
+def encode_done(seq: int) -> bytes:
+    return _U64.pack(seq)
+
+
+def decode_done(data: bytes) -> int:
+    return _U64.unpack_from(data, 0)[0]
+
+
+def encode_update(seq: int, chunk_offset: int, chunk_count: int,
+                  export: ForwardExport) -> bytes:
+    return _UPDATE_HEAD.pack(seq, chunk_offset, chunk_count) \
+        + encode_export(export)
+
+
+def decode_update(data: bytes):
+    seq, chunk_offset, chunk_count = _UPDATE_HEAD.unpack_from(data, 0)
+    export, _ = decode_export(data, _UPDATE_HEAD.size)
+    return seq, chunk_offset, chunk_count, export
+
+
+# ------------------------------------------------------ spill snapshot
+
+def encode_spill_state(spill) -> bytes:
+    """Full spill-tier contents for snapshot compaction: the sketch
+    dicts ride as one export payload (same wire codecs), gauge ages and
+    the remembered merge ages ride as side lists keyed by position/key.
+    """
+    export = ForwardExport()
+    export.histograms.extend(
+        (key, h[0], h[1], h[2], h[3], h[4], h[5], h[6])
+        for key, h in spill._histos.items())
+    export.sets.extend(spill._sets.items())
+    export.counters.extend(spill._counters.items())
+    export.gauges.extend((key, v) for key, (v, _a)
+                         in spill._gauges.items())
+    out = [encode_export(export)]
+    ages = [a for _v, a in spill._gauges.values()]
+    out.append(_U32.pack(len(ages)))
+    out.extend(_U32.pack(a) for a in ages)
+    out.append(_U32.pack(len(spill._merged_gauge_ages)))
+    for key, age in spill._merged_gauge_ages.items():
+        out.append(_pack_str(key.name) + _pack_str(key.type)
+                   + _pack_str(key.joined_tags) + _U32.pack(age))
+    return b"".join(out)
+
+
+def decode_spill_state(data: bytes, spill) -> None:
+    """Restore `spill` (a fresh SpillBuffer) from encode_spill_state
+    bytes."""
+    import numpy as np
+
+    from ..ingest.parser import MetricKey
+    export, off = decode_export(data, 0)
+    for key, means, weights, vmin, vmax, vsum, cnt, recip in (
+            export.histograms):
+        spill._histos[key] = [np.asarray(means, np.float32),
+                              np.asarray(weights, np.float32),
+                              float(vmin), float(vmax), float(vsum),
+                              float(cnt), float(recip)]
+    for key, regs in export.sets:
+        spill._sets[key] = np.asarray(regs, np.uint8)
+    for key, value in export.counters:
+        spill._counters[key] = float(value)
+    (n_ages,) = _U32.unpack_from(data, off)
+    off += _U32.size
+    ages = []
+    for _ in range(n_ages):
+        (a,) = _U32.unpack_from(data, off)
+        off += _U32.size
+        ages.append(a)
+    for (key, value), age in zip(export.gauges, ages):
+        spill._gauges[key] = [float(value), age]
+    (n_merged,) = _U32.unpack_from(data, off)
+    off += _U32.size
+    for _ in range(n_merged):
+        name, off = _unpack_str(data, off)
+        mtype, off = _unpack_str(data, off)
+        tags, off = _unpack_str(data, off)
+        (age,) = _U32.unpack_from(data, off)
+        off += _U32.size
+        spill._merged_gauge_ages[MetricKey(name, mtype, tags)] = age
+
+
+# --------------------------------------------- receiver (dedupe) marks
+
+def encode_watermarks(marks: dict) -> bytes:
+    out = [_U32.pack(len(marks))]
+    for sender_id, seq in marks.items():
+        out.append(_pack_str(sender_id) + _U64.pack(int(seq)))
+    return b"".join(out)
+
+
+def decode_watermarks(data: bytes) -> dict:
+    (n,) = _U32.unpack_from(data, 0)
+    off = _U32.size
+    marks = {}
+    for _ in range(n):
+        sender_id, off = _unpack_str(data, off)
+        (seq,) = _U64.unpack_from(data, off)
+        off += _U64.size
+        marks[sender_id] = seq
+    return marks

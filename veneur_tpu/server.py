@@ -141,8 +141,29 @@ class Server:
                 timeout_s=cfg.flush_timeout_seconds,
                 max_per_body=cfg.flush_max_per_body,
                 egress_policy=self._egress_policy)
-        if forwarder is not None and not isinstance(
-                forwarder, resilience.ResilientForwarder):
+        # Durable state (off by default): crash-safe journals for the
+        # sender's replay ladder + spill tier and the receiver's dedupe
+        # watermarks. Recovery runs HERE, in the constructor — before
+        # start() binds any listener — so a restarted process resumes
+        # its ladder under the original envelopes and a restarted
+        # global refuses ancient replays before the first RPC arrives.
+        self._forward_journal = None
+        self._dedupe_journal = None
+        # (by here a configured forward_address/consul service has
+        # already produced a concrete forwarder, so "will wrap" is
+        # simply "a forwarder exists and is not already resilient")
+        will_wrap = forwarder is not None and not isinstance(
+            forwarder, resilience.ResilientForwarder)
+        if cfg.durability_enabled and will_wrap:
+            from .durability import ForwardJournal
+            self._forward_journal = ForwardJournal(
+                cfg.durability_dir,
+                fsync=cfg.durability_fsync,
+                fsync_interval_s=_parse_interval(
+                    cfg.durability_fsync_interval),
+                snapshot_journal_bytes=(
+                    cfg.durability_snapshot_journal_bytes))
+        if will_wrap:
             # lossless-forward contract: terminal failures spill the
             # interval's sketches for re-merge into the next flush
             # instead of dropping them (resilience.SpillBuffer)
@@ -161,7 +182,10 @@ class Server:
                 # current send's own retry_deadline): a flush tick can
                 # stall at most ~3x retry_deadline, not
                 # spill_max_intervals x retry_deadline
-                replay_budget_s=2 * _parse_interval(cfg.retry_deadline))
+                replay_budget_s=2 * _parse_interval(cfg.retry_deadline),
+                # recovery happens inside the constructor: parked
+                # intervals come back with their original envelopes
+                journal=self._forward_journal)
         self.forwarder = forwarder   # callable(ForwardExport) or None
         # Receiver side of the exactly-once contract: one dedupe ledger
         # shared by the gRPC importsrv and the HTTP /import path, so a
@@ -176,6 +200,29 @@ class Server:
                     cfg.forward_dedupe_max_seqs_per_sender),
                 max_senders=cfg.forward_dedupe_max_senders,
                 ttl_s=_parse_interval(cfg.forward_dedupe_ttl))
+            if cfg.durability_enabled:
+                # recovery-before-listen: restore the per-sender
+                # watermarks the last incarnation flushed under, so an
+                # ancient replay (already flushed downstream before the
+                # crash) is dropped, not double-counted
+                from .durability import WatermarkJournal
+                self._dedupe_journal = WatermarkJournal(
+                    cfg.durability_dir,
+                    fsync=cfg.durability_fsync,
+                    fsync_interval_s=_parse_interval(
+                        cfg.durability_fsync_interval))
+                marks = self._dedupe_journal.load()
+                if marks:
+                    n = self.dedupe_ledger.restore_watermarks(marks)
+                    resilience.DEFAULT_REGISTRY.incr(
+                        "import", "durability.recovered_watermarks", n)
+                # watermarks are journaled ONE TICK BEHIND (see
+                # flush_once): a seq admitted mid-tick may still be
+                # sitting in a worker queue when this tick's engines
+                # drain, so only the PREVIOUS tick's snapshot — whose
+                # data has had a full interval to land and flush — is
+                # safe to make a durable hard-drop floor
+                self._pending_watermarks: dict = {}
         self._grpc_servers = []
         # tags_exclude strips tag names BEFORE key construction (metrics
         # differing only in an excluded tag aggregate together), in both
@@ -559,6 +606,16 @@ class Server:
                 s.stop()
             except Exception:
                 pass
+        # durable shutdown: push every journal record to disk and
+        # release the file handles, so a restart from the same
+        # durability_dir starts clean (the crash path skips this — the
+        # journal's torn-write tolerance covers it)
+        for j in (self._forward_journal, self._dedupe_journal):
+            if j is not None:
+                try:
+                    j.close()
+                except Exception:
+                    log.exception("durability journal close failed")
         if self.trace_client is not None:
             try:
                 self.trace_client.close()
@@ -1145,6 +1202,52 @@ class Server:
                     self._last_forward_err = sig
                 if self._sentry is not None and not repeat:
                     self._sentry.capture(e, "forward failed")
+        # durability flush boundary: fsync + compact the forward
+        # journal, and record the dedupe ledger's per-sender admitted
+        # watermarks (everything admitted up to here rides in flushed
+        # state no later than the NEXT tick — the one-interval fuzz is
+        # documented in README "Durable state")
+        if self._forward_journal is not None:
+            tick = getattr(self.forwarder, "journal_tick", None)
+            if tick is not None:
+                tick()   # journal failures degrade inside the forwarder
+        if self._dedupe_journal is not None and \
+                self.dedupe_ledger is not None:
+            try:
+                # record LAST tick's snapshot, capture this tick's: a
+                # seq admitted during this tick may not be in the state
+                # this tick flushed (worker-queue residency), so it
+                # only becomes a durable floor once a full interval has
+                # carried it into a flush. A crash loses at most the
+                # watermark advance of the last two ticks — replays of
+                # those seqs re-admit, which the receiver-side dedupe
+                # ledger bounds exactly as before durability existed.
+                marks = self._pending_watermarks
+                # vlint: disable=TH01 reason=flush-path-only state;
+                # flushes are serialized (one flusher thread, tests
+                # call flush_once synchronously)
+                self._pending_watermarks = \
+                    self.dedupe_ledger.max_admitted()
+                self._dedupe_journal.record(marks)
+                self._dedupe_journal.sync()
+            except Exception:
+                # a failing disk must not fail the flush tick; the
+                # in-memory ledger keeps deduping, only crash-restart
+                # watermark durability degrades (counted, loud)
+                resilience.DEFAULT_REGISTRY.incr(
+                    "import", "durability.journal_errors")
+                log.exception(
+                    "dedupe watermark journal failed; DISABLING it "
+                    "for this process (in-memory dedupe unaffected)")
+                try:
+                    self._dedupe_journal.close()
+                except Exception:
+                    pass
+                # vlint: disable=TH01 reason=flush-path-only state;
+                # flushes are serialized (one flusher thread, tests
+                # call flush_once synchronously) and stop() reads it
+                # only after the last tick ended
+                self._dedupe_journal = None
         with self._stats_lock:
             self.flush_count += 1
         return frameset
@@ -1207,6 +1310,19 @@ class Server:
         if self.dedupe_ledger is not None:
             out.append(mk("veneur.forward.dedupe_ledger_size",
                           self.dedupe_ledger.size(), MetricType.GAUGE))
+        journals = [j for j in (self._forward_journal,
+                                self._dedupe_journal) if j is not None]
+        if journals:
+            # counters (journal_appends/truncated_frames/recovered_*)
+            # ride the registry drain below; the level-style metrics
+            # are gauges and come straight from the journals
+            out.append(mk("veneur.durability.journal_bytes",
+                          sum(j.size_bytes() for j in journals),
+                          MetricType.GAUGE))
+            out.append(mk(
+                "veneur.durability.snapshot_duration_ns",
+                max(j.journal.last_snapshot_ns for j in journals),
+                MetricType.GAUGE))
         if eng_stats is not None:
             out += [
                 mk("veneur.samples.processed_total",

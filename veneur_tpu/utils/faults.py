@@ -21,6 +21,20 @@ forever once the script is exhausted):
                       cannot distinguish this from "timeout"; only an
                       idempotency envelope + receiver dedupe makes the
                       inevitable retry/replay safe.
+    "kill"            simulated HARD KILL at this exact wire moment:
+                      raises SimulatedKill, a BaseException, so it
+                      escapes every `except Exception` recovery arm in
+                      the egress/forwarder/flush stack exactly like
+                      SIGKILL would end the process — no parking, no
+                      journal appends, no breaker bookkeeping happen
+                      after it. The kill-restart chaos harness uses it
+                      to stop a sender mid-replay-ladder and then
+                      rebuild it from the durability journal.
+    "kill_after_send" the body is DELIVERED first (like "ack_lost"),
+                      then the kill fires — the crash window between a
+                      successful send and its journal DONE record,
+                      where only receiver-side dedupe of the recovered
+                      replay prevents a double count.
     503 (any int)     HTTP status: >=400 raises HTTPStatusError-shaped
                       failure via a fake response; <400 succeeds
     ("slow", dt)      advance the clock by dt seconds, then succeed
@@ -35,6 +49,25 @@ from __future__ import annotations
 
 import random
 import threading
+
+
+class SimulatedKill(BaseException):
+    """The scripted "kill" step. A BaseException on purpose: the
+    resilience layer's retry loops, the forwarder's park-on-failure
+    arms, and the flush loop's error counter all catch `Exception` —
+    a real SIGKILL bypasses every one of them, so the simulation must
+    too. Nothing (journal appends included) runs after this raises."""
+
+
+def kill_journal_lock(journal_like):
+    """Complete an in-process kill simulation: release the durability
+    journal's advisory process lock the way a real SIGKILL would (the
+    kernel closes the fd), WITHOUT flushing or closing the journal —
+    everything the next incarnation knows it must learn from the bytes
+    already on disk. Accepts a Journal or a ForwardJournal/
+    WatermarkJournal façade."""
+    journal = getattr(journal_like, "journal", journal_like)
+    journal.release_lock()
 
 
 class FakeClock:
@@ -146,6 +179,11 @@ class ScriptedTransport:
             self.clock.advance(float(step[1]))
             inner = step[2] if len(step) > 2 else "ok"
             return self._apply(inner, req)
+        if step == "kill":
+            raise SimulatedKill("scripted hard kill (nothing sent)")
+        if step == "kill_after_send":
+            self._deliver(req)
+            raise SimulatedKill("scripted hard kill (body was applied)")
         if step == "ack_lost":
             # the ambiguous failure: the body is consumed and APPLIED
             # by the receiver, then the response never makes it back
@@ -187,13 +225,17 @@ class ScriptedCallable(ScriptedTransport):
     def __call__(self, *args, timeout=None, **kwargs):
         step = self._next_step()
         self.calls.append((self.clock(), timeout, step, args))
-        if step == "ack_lost":
+        if step in ("ack_lost", "kill_after_send"):
             # ambiguous failure for callables: the delivery side
             # effects HAPPEN (recorded + on_success runs, e.g. a real
-            # gRPC send underneath), then the ack is dropped
+            # gRPC send underneath), then the ack is dropped — or the
+            # whole process "dies" before observing it
             self.delivered.append(args)
             if self.on_success is not None:
                 self.on_success(*args, **kwargs)
+            if step == "kill_after_send":
+                raise SimulatedKill(
+                    "scripted hard kill (body was applied)")
             raise TimeoutError("scripted ack lost (body was applied)")
         out = self._apply(step)          # raises on fault steps
         self.delivered.append(args)
