@@ -78,4 +78,25 @@ if [ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)"' "$OUT/BENCH_c8_tpu.json.
 else
     echo "c8 rc=$rc or not platform=tpu; keeping .tmp for forensics"
 fi
+# 4. Flight-recorder phase attribution + profiler capture on real TPU
+#    (ISSUE 6): run bench_suite c13 on the TPU path (phase coverage,
+#    /debug/flush same-tick check, recorder overhead), the live
+#    analogue of the CPU rows in BENCH_SUITE_r07.json. Against a RUNNING
+#    server started with `debug_flush_profile: true`, the on-demand
+#    xprof window is one curl away:
+#        curl "http://$HTTP_ADDR/debug/flush/profile?ticks=3"
+#        curl "http://$HTTP_ADDR/debug/flush" | python -m json.tool
+#    (the first schedules a jax.profiler capture around the next 3
+#    flush ticks into debug_flush_profile_dir; the second returns the
+#    phase timelines for exactly those ticks.)
+timeout 540 python bench_suite.py --config 13 \
+    --json-out "$OUT/BENCH_c13_tpu.json.tmp" \
+    > "$OUT/tpu_window_c13_$TS.log" 2>&1
+rc=$?
+if [ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)"' "$OUT/BENCH_c13_tpu.json.tmp"; then
+    mv "$OUT/BENCH_c13_tpu.json.tmp" "$OUT/BENCH_c13_tpu.json"
+    echo "c13 TPU flight-recorder rows captured (BENCH_c13_tpu.json)"
+else
+    echo "c13 rc=$rc or not platform=tpu; keeping .tmp for forensics"
+fi
 echo "window capture complete at $(date -u +%Y%m%dT%H%M%SZ)"

@@ -1,0 +1,549 @@
+"""Observability spine tests: the unified TelemetryRegistry (naming
+rules, drain/snapshot semantics), the FlightRecorder (ring bounds,
+phase trees, span emission), the server integration (phase coverage,
+/debug/flush, dogfood timers), and the chaos arms (ack-loss storms
+surface retry/replay phases; a SimulatedKill never corrupts the ring).
+"""
+
+import json
+import random
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from veneur_tpu.config import Config, read_config
+from veneur_tpu.metrics import MetricType
+from veneur_tpu.observe import (DEFAULT_REGISTRY, SERVER_SCOPE,
+                                FlightRecorder, TelemetryRegistry,
+                                current_tick, phase_timer_samples,
+                                reset_current_tick, set_current_tick)
+from veneur_tpu.resilience import (BreakerPolicy, Egress, EgressPolicy,
+                                   ResilientForwarder, RetryPolicy)
+from veneur_tpu.server import Server
+from veneur_tpu.sinks.basic import CaptureMetricSink
+from veneur_tpu.utils.faults import (FakeClock, ScriptedCallable,
+                                     ScriptedTransport, SimulatedKill,
+                                     seeded_schedule)
+
+_YAML = """
+interval: "3600s"
+num_workers: 1
+percentiles: [0.5, 0.99]
+aggregates: ["min", "max", "count"]
+hostname: h
+tpu_histogram_slots: 512
+tpu_counter_slots: 512
+tpu_gauge_slots: 512
+tpu_set_slots: 256
+tpu_batch_size: 256
+tpu_buffer_depth: 256
+"""
+
+
+# --------------------------------------------------------- registry
+
+def test_registry_drain_naming_rules():
+    r = TelemetryRegistry()
+    r.incr("dest", "spilled", 3)                 # plain -> resilience.*
+    r.incr("import", "forward.duplicates_dropped", 2)   # dotted
+    r.incr(SERVER_SCOPE, "packet.received", 7)   # server scope: no tags
+    r.mark("sink:cap", "sink.metrics_flushed", 0)  # zero still reports
+    r.set_gauge("sink:cap", "sink.flush_duration_ns", 123.0)
+    r.set_gauge(SERVER_SCOPE, "flush.total_duration_ns", 5.0)
+    out = {m.name: m for m in r.drain(1, "h")}
+    m = out["veneur.resilience.spilled_total"]
+    assert m.value == 3 and m.tags == ["destination:dest"] \
+        and m.type == MetricType.COUNTER
+    m = out["veneur.forward.duplicates_dropped_total"]
+    assert m.tags == ["destination:import"]
+    m = out["veneur.packet.received_total"]
+    assert m.value == 7 and m.tags == [] and m.hostname == "h"
+    m = out["veneur.sink.metrics_flushed_total"]
+    assert m.value == 0 and m.tags == ["sink:cap"]
+    m = out["veneur.sink.flush_duration_ns"]
+    assert m.type == MetricType.GAUGE and m.tags == ["sink:cap"]
+    assert out["veneur.flush.total_duration_ns"].value == 5.0
+    # drain resets counters AND gauges
+    assert r.drain(2) == []
+
+
+def test_registry_take_peek_compat_and_levels():
+    r = TelemetryRegistry()
+    r.incr("d", "attempts", 2)
+    r.incr("d", "attempts")
+    assert r.peek("d", "attempts") == 3
+    assert r.take() == {("d", "attempts"): 3}
+    assert r.take() == {}                      # drained
+    assert r.total("d", "attempts") == 3       # cumulative survives
+    r.incr_level(SERVER_SCOPE, "flush.count")
+    r.incr_level(SERVER_SCOPE, "flush.count")
+    assert r.level(SERVER_SCOPE, "flush.count") == 2
+    # levels never drain; they appear in snapshots as gauges
+    assert r.drain(1) == []
+    snap = {m.name: m for m in r.snapshot(1)}
+    assert snap["veneur.flush.count"].value == 2
+    assert snap["veneur.resilience.attempts_total"].value == 3
+
+
+# --------------------------------------------------------- recorder
+
+def test_recorder_phase_tree_and_ring_bounds():
+    fr = FlightRecorder(capacity=2, max_phases=8)
+    for i in range(3):
+        t = fr.begin_tick(100 + i)
+        with t.phase("drain"):
+            pass
+        p = t.start("forward")
+        t.start("egress.attempt", p)
+        t.finish(p, outcome="ok")
+        fr.end_tick(t)
+    snap = fr.snapshot()
+    assert len(snap) == 2                       # ring bound
+    assert snap[0]["tick_id"] == 3              # newest first
+    names = {p["name"]: p for p in snap[0]["phases"]}
+    assert names["egress.attempt"]["parent"] == 1
+    assert names["egress.attempt"]["in_flight"]   # never finished
+    assert names["forward"]["meta"] == {"outcome": "ok"}
+    assert fr.tick_count == 3
+
+
+def test_recorder_phase_overflow_drops_counted():
+    fr = FlightRecorder(capacity=1, max_phases=8)
+    t = fr.begin_tick(1)
+    idxs = [t.start(f"p{i}") for i in range(12)]
+    assert idxs[7] >= 0 and idxs[8] == -1
+    t.finish(idxs[8])                            # -1 is safe
+    fr.end_tick(t)
+    d = fr.snapshot()[0]
+    assert len(d["phases"]) == 8 and d["dropped_phases"] == 4
+
+
+def test_recorder_contextvar_scope():
+    fr = FlightRecorder()
+    assert current_tick() is None
+    t = fr.begin_tick(1)
+    tok = set_current_tick(t, parent=5)
+    try:
+        from veneur_tpu.observe import current_scope
+        sc = current_scope()
+        assert sc.tick is t and sc.parent == 5
+    finally:
+        reset_current_tick(tok)
+    assert current_tick() is None
+
+
+def test_recorder_emits_span_tree():
+    class FakeClient:
+        def __init__(self):
+            self.spans = []
+
+        def record(self, span):
+            self.spans.append(span)
+            return True
+
+    fr = FlightRecorder()
+    t = fr.begin_tick(7)
+    with t.phase("drain"):
+        pass
+    p = t.start("forward")
+    t.finish(t.start("egress.attempt", p))
+    t.finish(p)
+    t.start("hung")                               # in-flight: not emitted
+    fr.end_tick(t)
+    c = FakeClient()
+    n = fr.emit_spans(t, c)
+    assert n == 4                                 # root + 3 completed
+    by_name = {s.name: s for s in c.spans}
+    root = by_name["veneur.flush"]
+    assert root.parent_id == 0 and root.tags["tick_id"] == str(t.tick_id)
+    assert by_name["veneur.flush.drain"].parent_id == root.id
+    fwd = by_name["veneur.flush.forward"]
+    assert fwd.parent_id == root.id
+    assert by_name["veneur.flush.egress.attempt"].parent_id == fwd.id
+    assert all(s.end_timestamp >= s.start_timestamp for s in c.spans)
+
+
+def test_phase_timer_samples_are_local_only():
+    from veneur_tpu.ingest.parser import LOCAL_ONLY
+
+    fr = FlightRecorder()
+    t = fr.begin_tick(1)
+    with t.phase("engine"):
+        pass
+    p = t.start("forward")
+    t.finish(t.start("egress.attempt", p))        # child: not emitted
+    t.finish(p)
+    fr.end_tick(t)
+    samples = phase_timer_samples(t)
+    names = {m.key.name for m in samples}
+    assert names == {"veneur.flush.phase.engine",
+                     "veneur.flush.phase.forward",
+                     "veneur.flush.phase.total"}
+    assert all(m.scope == LOCAL_ONLY for m in samples)
+    assert all(m.key.type == "timer" for m in samples)
+    assert all(m.value >= 0.0 for m in samples)
+
+
+# ----------------------------------------------------- server ticks
+
+def _mk_server(extra_cfg=None, **server_kw):
+    cfg = read_config(text=_YAML)
+    cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    for k, v in (extra_cfg or {}).items():
+        setattr(cfg, k, v)
+    cap = CaptureMetricSink()
+    srv = Server(cfg, sinks=[cap], plugins=[], span_sinks=[],
+                 **server_kw)
+    srv.start()
+    return srv, cap
+
+
+def _feed(srv, n_keys=64, n_per_key=32):
+    lines = []
+    for k in range(n_keys):
+        for v in range(n_per_key):
+            lines.append(b"obs.t%d:%d.5|ms" % (k, v))
+    srv.handle_packet(b"\n".join(lines))
+    assert srv.drain(10.0)
+
+
+def test_flush_tick_phase_coverage_at_least_95pct():
+    """The acceptance gate: completed top-level phases must account for
+    >= 95% of the measured tick wall time (the same accounting
+    BENCH_SUITE_r07 records at the 100k-histogram config)."""
+    srv, cap = _mk_server()
+    try:
+        _feed(srv)
+        srv.flush_once(timestamp=10)
+        tick = srv.flight.last_tick()
+        assert tick is not None and tick.mono_end > 0
+        cov = tick.attributed_ns() / tick.duration_ns()
+        assert cov >= 0.95, f"phase coverage {cov:.1%} < 95%"
+        names = {p[0] for p in tick.phases()}
+        assert {"engine", "engine.flush", "engine.drain",
+                "engine.materialize", "telemetry",
+                "fanout"} <= names
+        assert any(n.startswith("engine.device") for n in names)
+    finally:
+        srv.stop()
+
+
+def test_debug_flush_endpoint_serves_the_measured_tick():
+    srv, cap = _mk_server({"http_address": "127.0.0.1:0"})
+    try:
+        _feed(srv, n_keys=8, n_per_key=4)
+        srv.flush_once(timestamp=11)
+        want = srv.flight.last_tick().tick_id
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http_api.port}/debug/flush",
+                timeout=5) as resp:
+            state = json.loads(resp.read())
+        ticks = state["flight_recorder"]["ticks"]
+        assert ticks[0]["tick_id"] == want
+        assert ticks[0]["duration_ns"] > 0
+        names = {p["name"] for p in ticks[0]["phases"]}
+        assert "engine" in names and "fanout" in names
+        assert state["flush_count"] == 1
+        # registry view rides along
+        assert "server" in state["registry"]
+        # profiler trigger is OFF by default -> 403, not 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http_api.port}"
+                "/debug/flush/profile?ticks=1", timeout=5)
+        assert ei.value.code == 403
+    finally:
+        srv.stop()
+
+
+def test_debug_flush_profile_trigger_gated_on():
+    srv, cap = _mk_server({"http_address": "127.0.0.1:0",
+                           "debug_flush_profile": True,
+                           "debug_flush_profile_dir": "/tmp/vprof-test"})
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http_api.port}"
+                "/debug/flush/profile?ticks=1", timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert out["capture_ticks"] == 1
+        srv.flush_once(timestamp=1)   # consumes the capture window
+        with srv._stats_lock:
+            assert not srv._profile_active
+            assert srv._profile_ticks <= 0
+    finally:
+        srv.stop()
+
+
+def test_dogfood_phase_timers_flush_as_tenant_metrics():
+    srv, cap = _mk_server()
+    try:
+        srv.flush_once(timestamp=1)
+        assert srv.drain(10.0)         # phase samples land in workers
+        srv.flush_once(timestamp=2)
+        cap.wait_for_flush(2)
+        names = {m.name for m in cap.flushes[1]}
+        phase_metrics = {n for n in names
+                         if n.startswith("veneur.flush.phase.")}
+        # timers flush as percentiles + aggregates of the phase name
+        assert any("veneur.flush.phase.total" in n
+                   for n in phase_metrics), names
+        assert any("veneur.flush.phase.engine" in n
+                   for n in phase_metrics)
+    finally:
+        srv.stop()
+
+
+def test_flight_recorder_off_is_clean():
+    srv, cap = _mk_server({"flight_recorder": False,
+                           "http_address": "127.0.0.1:0"})
+    try:
+        _feed(srv, n_keys=4, n_per_key=4)
+        srv.flush_once(timestamp=1)
+        cap.wait_for_flush(1)
+        assert srv.flight is None
+        assert srv.flush_count == 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http_api.port}/debug/flush",
+                timeout=5) as resp:
+            state = json.loads(resp.read())
+        assert state["flight_recorder"] is None
+        # no dogfood timers either (they come from the recorder)
+        srv.flush_once(timestamp=2)
+        cap.wait_for_flush(2)
+        assert not any(m.name.startswith("veneur.flush.phase.")
+                       for m in cap.flushes[1])
+    finally:
+        srv.stop()
+
+
+def test_per_sink_phases_and_skip_counter():
+    import threading
+
+    from veneur_tpu.sinks import MetricSink
+
+    class WedgedSink(MetricSink):
+        def __init__(self):
+            self.release = threading.Event()
+
+        def name(self):
+            return "wedged"
+
+        def flush(self, metrics):
+            pass
+
+        def flush_frames(self, frames):
+            self.release.wait(20.0)
+            return 0
+
+    slow = WedgedSink()
+    cfg = Config(interval="3600s", hostname="h",
+                 tpu_histogram_slots=256, tpu_counter_slots=128,
+                 tpu_gauge_slots=128, tpu_set_slots=64)
+    cap = CaptureMetricSink()
+    srv = Server(cfg, sinks=[slow, cap], plugins=[], span_sinks=[])
+    srv.start()
+    try:
+        srv.flush_once(timestamp=1)
+        cap.wait_for_flush(1)
+        t1 = srv.flight.last_tick()
+        # the wedged sink's phase is in flight in the recorded tick
+        wedged = [dict(zip(("name", "t0", "t1", "parent"), p))
+                  for p in t1.phases() if p[0] == "sink.flush"]
+        assert any(w["t1"] == 0 for w in wedged)
+        srv.flush_once(timestamp=2)    # wedged still in flight -> skip
+        t2 = srv.flight.last_tick()
+        assert any(p[0] == "sink.skip" for p in t2.phases())
+    finally:
+        slow.release.set()
+        srv.stop()
+
+
+# ------------------------------------------------------- chaos arms
+
+def _scripted_forwarder(schedule, reg):
+    from veneur_tpu.cluster.forward import HttpJsonForwarder
+
+    clock = FakeClock()
+    egress = Egress(
+        "chaos",
+        policy=EgressPolicy(
+            retry=RetryPolicy(max_attempts=3, base_backoff_s=0.001,
+                              max_backoff_s=0.002, deadline_s=120.0),
+            breaker=BreakerPolicy(failure_threshold=10_000)),
+        transport=ScriptedTransport(schedule, clock),
+        clock=clock, sleep=clock.sleep, rng=random.Random(42),
+        registry=reg)
+    inner = HttpJsonForwarder("http://scripted:1", timeout_s=5.0,
+                              max_per_body=100, egress=egress)
+    return ResilientForwarder(inner, destination="chaos",
+                              sender_id="obs-sender", registry=reg)
+
+
+def test_ack_loss_storm_surfaces_retry_and_replay_phases():
+    """A seeded ack-loss storm's retries and replays must appear as
+    phases in the recorded ticks, nested under `forward`."""
+    reg = TelemetryRegistry()
+    # tick 1: ack lost then retry ok; tick 2: hard fail (parks);
+    # tick 3: replay ok + current ok; tick 4: a SEEDED ambiguous storm
+    # (ends in "ok" so the ladder terminates)
+    fwd = _scripted_forwarder(
+        ["ack_lost", "ok", "refused", "refused", "refused", "ok", "ok"]
+        + seeded_schedule(101, 8, p_fail=0.6, ambiguous=True),
+        reg)
+    cfg = read_config(text=_YAML)
+    cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    cfg.forward_address = "placeholder:1"
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                 span_sinks=[], forwarder=fwd)
+    srv.start()
+    try:
+        port = srv.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        ticks = []
+        for r in range(4):
+            c.sendto(b"obs.chaos:%d|c|#veneurglobalonly" % (r + 1),
+                     ("127.0.0.1", port))
+            deadline = time.monotonic() + 10
+            while srv.packets_received < 1 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert srv.drain(10.0)
+            try:
+                srv.flush_once(timestamp=100 + r)
+            except Exception:
+                pass   # tick 2's terminal failure parks the interval
+            ticks.append(srv.flight.last_tick())
+        c.close()
+        names0 = [p[0] for p in ticks[0].phases()]
+        # tick 1: ambiguous loss then a retried attempt, both under
+        # forward
+        assert names0.count("egress.attempt") >= 2
+        fwd_idx = names0.index("forward")
+        attempts = [p for p in ticks[0].phases()
+                    if p[0] == "egress.attempt"]
+        assert all(p[3] == fwd_idx for p in attempts)
+        assert "forward.send" in names0
+        # tick 3: the parked interval replays before the current send
+        names2 = [p[0] for p in ticks[2].phases()]
+        assert "forward.replay" in names2
+        assert names2.index("forward.replay") < \
+            names2.index("forward.send")
+        # tick 4 (the seeded storm): its retries show as attempt
+        # phases with failure outcomes in the meta
+        storm = [dict(zip(("name", "t0", "t1", "parent"), p))
+                 for p in ticks[3].phases()
+                 if p[0] == "egress.attempt"]
+        assert len(storm) >= 2
+        metas = [s for s in ticks[3]._slots[:ticks[3].n]
+                 if s.name == "egress.attempt"]
+        assert any(m.meta and m.meta.get("outcome") != "ok"
+                   for m in metas)
+        assert any(m.meta and m.meta.get("outcome") == "ok"
+                   for m in metas)
+        # and the storm's counters rode the unified registry
+        assert reg.peek("chaos", "retries") >= 1
+        assert reg.total("chaos", "replayed") >= 1
+    finally:
+        srv.stop()
+
+
+def test_simulated_kill_never_corrupts_the_ring():
+    """A SimulatedKill (BaseException, like SIGKILL) escaping
+    mid-forward must leave the recorder ring readable and the next
+    tick recording cleanly — recorder state is process-local, no
+    journal interaction."""
+    reg = TelemetryRegistry()
+    kill_fwd = ScriptedCallable(["kill"])
+    cfg = read_config(text=_YAML)
+    cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    cfg.forward_address = "placeholder:1"
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                 span_sinks=[],
+                 forwarder=ResilientForwarder(
+                     kill_fwd, destination="kill", registry=reg))
+    srv.start()
+    try:
+        port = srv.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c.sendto(b"obs.k:1|c|#veneurglobalonly", ("127.0.0.1", port))
+        deadline = time.monotonic() + 10
+        while srv.packets_received < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.drain(10.0)
+        with pytest.raises(SimulatedKill):
+            srv.flush_once(timestamp=1)
+        c.close()
+        # the killed tick is closed and serializable
+        killed = srv.flight.last_tick()
+        assert killed.mono_end > 0
+        json.dumps(srv.flight.snapshot())      # no corruption
+        assert current_tick() is None          # scope was restored
+        # the next tick records cleanly on the same ring
+        srv.forwarder = None
+        srv.flush_once(timestamp=2)
+        t2 = srv.flight.last_tick()
+        assert t2.tick_id == killed.tick_id + 1
+        assert t2.attributed_ns() > 0
+        json.dumps(srv.flight.snapshot())
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------- scrape surface
+
+def test_prometheus_sink_exposes_unified_registry():
+    from veneur_tpu.sinks.prometheus import PrometheusMetricSink
+
+    reg = TelemetryRegistry()
+    reg.incr("dest", "attempts", 5)
+    reg.incr_level(SERVER_SCOPE, "flush.count", 2)
+    sink = PrometheusMetricSink("127.0.0.1:0", registries=(reg,))
+    sink.start()
+    try:
+        from veneur_tpu.metrics import InterMetric
+        sink.flush([InterMetric(name="api.hits", timestamp=1, value=3,
+                                type=MetricType.COUNTER)])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{sink.port}/metrics",
+                timeout=5) as resp:
+            text = resp.read().decode()
+        assert "api_hits 3" in text
+        assert 'veneur_resilience_attempts_total{destination="dest"} 5' \
+            in text
+        assert "veneur_flush_count 2" in text
+        # cumulative across drains: a drain must not zero the scrape
+        reg.drain(2)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{sink.port}/metrics",
+                timeout=5) as resp:
+            text = resp.read().decode()
+        assert 'veneur_resilience_attempts_total{destination="dest"} 5' \
+            in text
+    finally:
+        sink.stop()
+
+
+def test_prometheus_cli_self_metrics_surface():
+    from veneur_tpu.cli.prometheus import start_self_metrics_server
+
+    reg = TelemetryRegistry()
+    reg.incr(SERVER_SCOPE, "prometheus.polls", 4)
+    reg.incr(SERVER_SCOPE, "prometheus.series_relayed", 17)
+    sink = start_self_metrics_server("127.0.0.1:0", reg)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{sink.port}/metrics",
+                timeout=5) as resp:
+            text = resp.read().decode()
+        assert "veneur_prometheus_polls_total 4" in text
+        assert "veneur_prometheus_series_relayed_total 17" in text
+    finally:
+        sink.stop()
+
+
+def test_default_registry_is_the_resilience_registry():
+    from veneur_tpu import resilience
+    assert resilience.DEFAULT_REGISTRY is DEFAULT_REGISTRY
+    assert resilience.ResilienceRegistry is TelemetryRegistry

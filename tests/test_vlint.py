@@ -101,6 +101,28 @@ def test_sr02_allows_the_ops_module_itself():
     assert [v for v in run_paths([path]) if v.rule == "SR02"] == []
 
 
+def test_tl01_adhoc_self_metric_names():
+    # the hand-built InterMetric (13), the f-string head (17), and the
+    # raw dict counter's two literals (21/22); the docstring mention,
+    # the suppressed legacy exporter, and the non-matching prefix all
+    # stay silent
+    assert lint("tl01_bad.py") == [("TL01", 13), ("TL01", 17),
+                                   ("TL01", 21), ("TL01", 22)]
+
+
+def test_tl01_allows_the_registry_itself():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "observe", "registry.py")
+    assert [v for v in run_paths([path]) if v.rule == "TL01"] == []
+
+
+def test_tl01_out_of_scope_modules_unchecked():
+    # tooling outside veneur_tpu/ may spell metric names freely
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "tools", "vlint", "py_checks.py")
+    assert [v for v in run_paths([path]) if v.rule == "TL01"] == []
+
+
 def test_clean_fixture_is_clean():
     assert lint("clean.py") == []
 

@@ -51,4 +51,15 @@ DEFAULT_CONFIG = {
     "dr01_allow": (
         "veneur_tpu/durability/journal.py",
     ),
+    # TL01: where the veneur.* self-metric naming monopoly applies
+    # (path substring match; /tl01_ scopes the check's own fixture in)
+    # and the one module allowed to mint those names — the unified
+    # telemetry registry owns the key -> wire-name mapping.
+    "tl01_scope": (
+        "veneur_tpu/",
+        "/tl01_",
+    ),
+    "tl01_allow": (
+        "veneur_tpu/observe/registry.py",
+    ),
 }

@@ -1,4 +1,5 @@
-"""Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02, DR01.
+"""Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02, DR01,
+TL01.
 
 All checks are intentionally conservative: they resolve only what can
 be resolved statically within the project (local jit wrappers, module
@@ -823,6 +824,63 @@ def check_dr01(mod: PyModule, config: dict) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------------------- TL01
+
+_TL01_PREFIX = "veneur."
+
+
+def check_tl01(mod: PyModule, config: dict) -> list[Violation]:
+    """Self-metric naming monopoly: every `veneur.*` self-metric name
+    in the serving tree must be minted by the unified telemetry
+    registry (observe/registry.py — TelemetryRegistry.drain,
+    phase_timer_samples, flush_span_name). A string literal starting
+    with "veneur." anywhere else is an ad-hoc emission surface — the
+    exact three-disjoint-registries drift this check exists to prevent
+    (an InterMetric built by hand, a raw dict counter drained with its
+    own name mapping, a second span-name spelling). Docstrings are
+    exempt (documentation names metrics); deliberate emitters suppress
+    with a reason."""
+    if not any(m in mod.path for m in config["tl01_scope"]):
+        return []
+    if any(mod.path.endswith(a) for a in config["tl01_allow"]):
+        return []
+    # docstring Constants: the first statement of a module/class/def
+    docstrings = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                    body[0].value, ast.Constant) and isinstance(
+                    body[0].value.value, str):
+                docstrings.add(id(body[0].value))
+    # constants living inside an f-string report via their JoinedStr
+    fstring_parts = {id(v) for node in ast.walk(mod.tree)
+                     if isinstance(node, ast.JoinedStr)
+                     for v in node.values}
+    out = []
+    for node in ast.walk(mod.tree):
+        lit = None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if id(node) in docstrings or id(node) in fstring_parts:
+                continue
+            lit = node.value
+        elif isinstance(node, ast.JoinedStr) and node.values and \
+                isinstance(node.values[0], ast.Constant) and \
+                isinstance(node.values[0].value, str):
+            # f"veneur.{name}_total" — the statically-visible head
+            lit = node.values[0].value
+        if lit is not None and lit.startswith(_TL01_PREFIX):
+            out.append(Violation(
+                mod.path, node.lineno, "TL01",
+                f"ad-hoc veneur.* self-metric name {lit!r} outside the "
+                "telemetry registry — veneur.* naming lives in "
+                "observe/registry.py (TelemetryRegistry.drain / "
+                "phase_timer_samples / flush_span_name); count through "
+                "the registry or suppress with a reason"))
+    return out
+
+
 # ------------------------------------------------------------------- driver
 
 def check_module(mod: PyModule, ctx: Context, config: dict
@@ -836,4 +894,5 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_rs01(mod, config))
     out.extend(check_sr02(mod, config))
     out.extend(check_dr01(mod, config))
+    out.extend(check_tl01(mod, config))
     return out

@@ -6,6 +6,12 @@ the exposition text, translate each family — counters as deltas since
 the previous poll (first poll primes the cache), gauges as absolute
 values, histogram/summary components as their counter/gauge parts — and
 emit statsd lines with the Prometheus labels as tags.
+
+The relay's own telemetry (polls, poll errors, series relayed, send
+errors) flows through the unified registry (observe/registry.py) like
+every other veneur.* self-metric, and `--self-metrics-address` serves
+it as one Prometheus scrape surface — previously the only view was
+partial (log lines), invisible to scrapers.
 """
 
 from __future__ import annotations
@@ -104,7 +110,24 @@ def poll_once(url: str, prev: dict, prefix: str = "",
     return to_statsd_lines(parse_exposition(text), prev, prefix)
 
 
+def start_self_metrics_server(address: str, registry):
+    """Expose the relay's own unified-registry telemetry as a
+    Prometheus scrape surface: the exposition server IS the sink's
+    (one implementation of address parsing / routing / content type),
+    just with no flush body — only the live registry snapshot.
+    Returns the started sink (.port is the bound port, .stop() tears
+    it down)."""
+    from ..sinks.prometheus import PrometheusMetricSink
+
+    sink = PrometheusMetricSink(listen_address=address,
+                                registries=(registry,))
+    sink.start()
+    return sink
+
+
 def main(argv=None) -> int:
+    from ..observe import SERVER_SCOPE, TelemetryRegistry
+
     ap = argparse.ArgumentParser(prog="veneur-prometheus")
     ap.add_argument("-p", "--prometheus-host",
                     default="http://localhost:9090/metrics",
@@ -115,6 +138,10 @@ def main(argv=None) -> int:
     ap.add_argument("--prefix", default="", help="metric name prefix")
     ap.add_argument("--once", action="store_true",
                     help="poll twice back-to-back and exit (testing)")
+    ap.add_argument("--self-metrics-address", default="",
+                    help="serve the relay's own veneur.prometheus.* "
+                         "telemetry (unified registry) for scraping, "
+                         "e.g. 127.0.0.1:9126")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -128,15 +155,28 @@ def main(argv=None) -> int:
     family = socket.AF_INET6 if ":" in dest[0] else socket.AF_INET
     sock = socket.socket(family, socket.SOCK_DGRAM)
 
+    registry = TelemetryRegistry()
+    if args.self_metrics_address:
+        start_self_metrics_server(args.self_metrics_address, registry)
+
     prev: dict = {}
     n_polls = 0
     while True:
         try:
             lines = poll_once(args.prometheus_host, prev, args.prefix)
+            sent = 0
             for ln in lines:
-                sock.sendto(ln, dest)
-            log.info("relayed %d series", len(lines))
+                try:
+                    sock.sendto(ln, dest)
+                    sent += 1
+                except OSError:
+                    registry.incr(SERVER_SCOPE, "prometheus.send_errors")
+            registry.incr(SERVER_SCOPE, "prometheus.polls")
+            registry.incr(SERVER_SCOPE, "prometheus.series_relayed",
+                          sent)
+            log.info("relayed %d series", sent)
         except Exception as e:
+            registry.incr(SERVER_SCOPE, "prometheus.poll_errors")
             log.error("poll failed: %s", e)
         n_polls += 1
         if args.once and n_polls >= 2:

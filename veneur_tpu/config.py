@@ -126,6 +126,26 @@ class Config:
     # (checked at flush boundaries; atomic write-temp/fsync/rename)
     durability_snapshot_journal_bytes: int = 1 << 22
 
+    # --- observability (veneur_tpu/observe/) ---
+    # Flight recorder: every flush tick records its phase tree (drain /
+    # device dispatch / device exec / materialize / sink fan-out /
+    # forward ladder / journal ops) into a bounded ring served by
+    # GET /debug/flush and replayed as SSF spans through the server's
+    # own trace client. Overhead is one monotonic_ns stamp + index bump
+    # per phase edge (bench_suite c13 pins it under 1% of the tick).
+    flight_recorder: bool = True
+    flight_recorder_ticks: int = 32        # ring: last N ticks kept
+    flight_recorder_max_phases: int = 192  # per-tick phase slot budget
+    # Dogfood loop: re-ingest each tick's top-level phase durations as
+    # LOCAL-ONLY `veneur.flush.phase.*` timers, so the engine serves
+    # percentiles of its own flush phases like any tenant metric.
+    flush_phase_timers: bool = True
+    # On-demand jax.profiler capture around flush ticks, triggered via
+    # GET /debug/flush/profile?ticks=N. Off by default: a profiler
+    # capture is a debug action an operator must opt into.
+    debug_flush_profile: bool = False
+    debug_flush_profile_dir: str = "veneur-profile"
+
     # --- TLS (statsd/SSF stream listeners) ---
     tls_key: str = ""
     tls_certificate: str = ""
@@ -297,6 +317,15 @@ def _validate(cfg: Config) -> None:
                 "forward_dedupe_max_senders"):
         if getattr(cfg, key) < 1:
             raise ValueError(f"{key} must be >= 1")
+    if cfg.flight_recorder_ticks < 1 or \
+            cfg.flight_recorder_max_phases < 8:
+        raise ValueError(
+            "flight_recorder_ticks must be >= 1 and "
+            "flight_recorder_max_phases >= 8 (a tick's fixed phases "
+            "alone need that many slots)")
+    if cfg.debug_flush_profile and not cfg.debug_flush_profile_dir:
+        raise ValueError(
+            "debug_flush_profile requires a debug_flush_profile_dir")
     if cfg.spill_max_sketches < 0 or \
             cfg.spill_gauge_max_age_intervals < 0:
         raise ValueError(

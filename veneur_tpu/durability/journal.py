@@ -264,7 +264,9 @@ class Journal:
         self.fsync_interval_s = fsync_interval_s
         self._clock = clock
         if registry is None:
-            from ..resilience import DEFAULT_REGISTRY
+            # the unified telemetry spine (observe/registry.py) — the
+            # same process-default instance resilience re-exports
+            from ..observe.registry import DEFAULT_REGISTRY
             registry = DEFAULT_REGISTRY
         self._registry = registry
         self._destination = destination

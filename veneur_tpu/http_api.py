@@ -1,13 +1,17 @@
-"""Debug/ops HTTP server: healthchecks, version, and the legacy JSON
-import path.
+"""Debug/ops HTTP server: healthchecks, version, the legacy JSON
+import path, and the flush introspection surface.
 
 Parity: handlers.go (sym: Server.Serve / HTTPServe — /healthcheck,
 /healthcheck/tcp, /version, /builddate) and handlers_global.go (sym:
 Server.handleImport — POST /import with a []JSONMetric body; the Go gob
 digest blobs are JSON centroid arrays here, matching what
 cluster.forward.HttpJsonForwarder emits). The reference also exposes
-net/http/pprof; the Python analogue is GET /debug/threads (a stack dump
-of every thread).
+net/http/pprof; the Python analogues are GET /debug/threads (a stack
+dump of every thread) and GET /debug/flush — the flight recorder's
+ring of phase-attributed flush ticks plus breaker/ladder/journal/
+dedupe-ledger state (schema in README "Observability"), with
+GET /debug/flush/profile?ticks=N triggering an on-demand jax.profiler
+capture when the server was configured with debug_flush_profile.
 """
 
 from __future__ import annotations
@@ -18,6 +22,7 @@ import sys
 import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 from . import __version__
 from .cluster import wire
@@ -72,12 +77,19 @@ class HttpApi:
     imported metric onto a worker queue (the Server provides it)."""
 
     def __init__(self, address: str, submit=None, healthy=None,
-                 ledger=None):
+                 ledger=None, debug_state=None, profile=None):
+        """`debug_state()` (optional) returns the JSON-ready dict for
+        GET /debug/flush; `profile(ticks)` (optional) schedules an
+        on-demand jax.profiler capture — absent means the knob is off
+        and the endpoint answers 403, so an operator can tell "not
+        enabled" from "not a server with an engine" (404)."""
         host, _, port = address.rpartition(":")
         host = host.strip("[]") or "0.0.0.0"
         self._submit = submit
         self._healthy = healthy or (lambda: True)
         self._ledger = ledger   # cluster.importsrv.DedupeLedger or None
+        self._debug_state = debug_state
+        self._profile = profile
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -111,8 +123,38 @@ class HttpApi:
                         if f is not None:
                             out.extend(traceback.format_stack(f))
                     self._reply(200, "\n".join(out).encode())
+                elif self.path.startswith("/debug/flush"):
+                    self._debug_flush()
                 else:
                     self._reply(404, b"not found\n")
+
+            def _debug_flush(self):
+                u = urlparse(self.path)
+                if u.path.rstrip("/") == "/debug/flush/profile":
+                    if api._profile is None:
+                        self._reply(403, b"profiler capture disabled "
+                                         b"(set debug_flush_profile)\n")
+                        return
+                    try:
+                        ticks = int(parse_qs(u.query).get(
+                            "ticks", ["1"])[0])
+                    except ValueError:
+                        self._reply(400, b"ticks must be an integer\n")
+                        return
+                    self._reply(200, json.dumps(
+                        api._profile(ticks)).encode(),
+                        "application/json")
+                    return
+                if u.path.rstrip("/") != "/debug/flush":
+                    self._reply(404, b"not found\n")
+                    return
+                if api._debug_state is None:
+                    self._reply(404, b"no flush state on this "
+                                     b"listener\n")
+                    return
+                state = api._debug_state()
+                self._reply(200, json.dumps(
+                    state, default=str).encode(), "application/json")
 
             def do_POST(self):
                 if self.path != "/import":

@@ -1137,15 +1137,42 @@ class AggregationEngine:
          self.gauge_bank, self.set_bank) = self._fresh_fn()
         return snap
 
-    def _flush_device(self, snap) -> dict:
+    def _flush_device(self, snap, phases=None) -> dict:
         """Run the fused flush program on the snapshot and fetch the
         compact host arrays: ONE program dispatch + ONE device_get (on a
         tunneled TPU backend the transfer IS the flush cost; the program
         itself is ~0.2ms at 100k slots, TPU_EVIDENCE_r04.md §1).
         `flush_fetch` picks how the fetch is performed (see EngineConfig).
-        Overridden by the mesh engine."""
+        Overridden by the mesh engine.
+
+        `phases` (flight-recorder stamp list, appended in place) splits
+        the merge into dispatch / device exec / fetch — but ONLY under
+        the sync fetch mode: the split's block_until_ready is a plain
+        host sync, and on a relayed backend an extra sync can poison the
+        serving executable exactly like an eager device_get (the reason
+        the staged/host/async modes exist), so those modes record one
+        combined `device` phase instead of paying a second sync."""
         hb, cb, gb, sb = snap
-        return self._fetch_flush(self._flush_exec(hb, cb, gb, sb, self._qs))
+        if phases is None:
+            return self._fetch_flush(
+                self._flush_exec(hb, cb, gb, sb, self._qs))
+        t0 = time.monotonic_ns()
+        out = self._flush_exec(hb, cb, gb, sb, self._qs)
+        t1 = time.monotonic_ns()
+        if self.cfg.flush_fetch == "sync":
+            jax.block_until_ready(out)
+            t2 = time.monotonic_ns()
+            host = self._fetch_flush(out)
+            t3 = time.monotonic_ns()
+            phases.append(("device.dispatch", t0, t1))
+            phases.append(("device.exec", t1, t2))
+            phases.append(("device.fetch", t2, t3))
+        else:
+            host = self._fetch_flush(out)
+            t3 = time.monotonic_ns()
+            phases.append(("device.dispatch", t0, t1))
+            phases.append(("device", t1, t3))
+        return host
 
     def _fetch_flush(self, out):
         """device_get under the configured flush_fetch mode (shared with
@@ -1163,7 +1190,7 @@ class AggregationEngine:
         immutable snapshot while ingest continues into fresh banks."""
         ts = int(timestamp if timestamp is not None else time.time())
         cfg = self.cfg
-        t_start = time.perf_counter()
+        t_start = time.monotonic_ns()
         with self.lock:
             self.drain_all()
             self._flush_import_centroids()
@@ -1190,10 +1217,14 @@ class AggregationEngine:
                        self.gauge_keys, self.set_keys):
                 ki.advance_interval()
 
-        t_swap = time.perf_counter()
+        t_swap = time.monotonic_ns()
         fwd_out = self._fwd_out
-        host = self._flush_device(snap)
-        t_device = time.perf_counter()
+        # flight-recorder stamps: (name, t0_ns, t1_ns) on the shared
+        # monotonic_ns clock, returned in stats["phases"] so the server
+        # can graft them into the tick's phase tree with real edges
+        phases = [("drain", t_start, t_swap)]
+        host = self._flush_device(snap, phases=phases)
+        t_device = time.monotonic_ns()
 
         frame = MetricFrame(ts, cfg.hostname)
         export = ForwardExport()
@@ -1339,16 +1370,18 @@ class AggregationEngine:
                 hostname=sc.hostname or cfg.hostname)
             for sc in status.values()]
 
-        t_end = time.perf_counter()
+        t_end = time.monotonic_ns()
+        phases.append(("materialize", t_device, t_end))
         stats = {
             "samples": stats_samples,
             "histo_keys": histo_key_count,
             "dropped_no_slot": dropped,
             # Flush phase durations (veneur's flush.*_duration_ns
             # self-metrics; flusher.go sym: Server.Flush spans).
-            "swap_ns": int((t_swap - t_start) * 1e9),
-            "merge_ns": int((t_device - t_swap) * 1e9),
-            "assembly_ns": int((t_end - t_device) * 1e9),
+            "swap_ns": t_swap - t_start,
+            "merge_ns": t_device - t_swap,
+            "assembly_ns": t_end - t_device,
+            "phases": phases,
         }
         return FlushResult(frame=frame, export=export, stats=stats,
                            status_metrics=status_metrics)

@@ -262,9 +262,12 @@ class MeshAggregationEngine(AggregationEngine):
         self.me.banks = self.me._fresh_fn()
         return snap
 
-    def _flush_device(self, snap) -> dict:
+    def _flush_device(self, snap, phases=None) -> dict:
         """Collective merge over the mesh, mapped onto the host-dict
-        contract the shared assembly consumes."""
+        contract the shared assembly consumes. `phases` (the flight
+        recorder's stamp list) is accepted for signature parity with
+        the single-device engine; the mesh program is one collective
+        dispatch+fetch, recorded by the caller as the merge phase."""
         dev = self._fetch_flush(self.me.flush_device(snap))
         agg = dev["agg"]
         host = {

@@ -69,6 +69,10 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .observe.recorder import current_scope as _current_scope
+from .observe.registry import DEFAULT_REGISTRY  # noqa: F401  (re-export)
+from .observe.registry import TelemetryRegistry as ResilienceRegistry
+
 log = logging.getLogger("veneur_tpu.resilience")
 
 
@@ -259,38 +263,14 @@ def policy_from_config(cfg) -> EgressPolicy:
 
 
 # ------------------------------------------------------------- registry
-
-class ResilienceRegistry:
-    """Per-destination counters, drained once per flush by the server
-    into veneur.resilience.*_total self-metrics."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: dict[tuple[str, str], int] = {}
-
-    def incr(self, destination: str, counter: str, n: int = 1):
-        if n == 0:
-            return
-        with self._lock:
-            key = (destination, counter)
-            self._counters[key] = self._counters.get(key, 0) + n
-
-    def take(self) -> dict[tuple[str, str], int]:
-        """Drain: return-and-reset (interval-delta semantics, like the
-        server's other self-telemetry counters)."""
-        with self._lock:
-            out, self._counters = self._counters, {}
-        return out
-
-    def peek(self, destination: str, counter: str) -> int:
-        with self._lock:
-            return self._counters.get((destination, counter), 0)
-
-
-# The process-default registry: egress objects constructed without an
-# explicit registry (config-built sinks, forwarders) count here, and
-# Server._self_metrics drains it.
-DEFAULT_REGISTRY = ResilienceRegistry()
+#
+# The per-destination counter registry grew into the process-wide
+# telemetry spine (observe/registry.py) — one registry class for the
+# egress counters here, the durability journal counters, AND the
+# server's own accounting, with the veneur.* name mapping owned by the
+# observe module (vlint TL01). `ResilienceRegistry` (imported at the
+# top of this module) stays exported under the historical name; the
+# contracts of incr/take/peek are unchanged.
 
 
 # -------------------------------------------------------------- breaker
@@ -430,8 +410,18 @@ class Egress:
         underlying error with CircuitOpenError mid-ladder."""
         retry = self.policy.retry
         reg, dest = self.registry, self.destination
+        # flight-recorder attribution: when a flush tick is in progress
+        # on THIS thread (the forward path), every attempt/backoff gets
+        # its own phase; egress from other threads (span sinks) sees no
+        # tick and records nothing.
+        sc = _current_scope()
+        tick = sc.tick if sc is not None else None
+        par = sc.parent if sc is not None else -1
         if not self.breaker.allow():
             reg.incr(dest, "breaker_rejected")
+            if tick is not None:
+                tick.finish(tick.start("egress.breaker_rejected", par),
+                            destination=dest)
             raise CircuitOpenError(
                 f"{dest}: circuit open, call rejected")
         if deadline is None:
@@ -440,6 +430,8 @@ class Egress:
         while True:
             attempt += 1
             reg.incr(dest, "attempts")
+            ph = -1 if tick is None else tick.start("egress.attempt",
+                                                    par)
             try:
                 if timeout_s is not None:
                     remaining = deadline - self._clock()
@@ -447,6 +439,9 @@ class Egress:
                         0.001, min(timeout_s, remaining))
                 out = fn(*args, **kwargs)
             except Exception as e:
+                if tick is not None:
+                    tick.finish(ph, destination=dest, attempt=attempt,
+                                outcome=type(e).__name__)
                 now = self._clock()
                 if (not is_retryable(e) or attempt >= retry.max_attempts
                         or now >= deadline):
@@ -459,8 +454,15 @@ class Egress:
                 delay = min(delay, max(0.0, deadline - now))
                 reg.incr(dest, "retries")
                 if delay > 0:
+                    bp = -1 if tick is None else \
+                        tick.start("egress.backoff", par)
                     self._sleep(delay)
+                    if tick is not None:
+                        tick.finish(bp, destination=dest)
                 continue
+            if tick is not None:
+                tick.finish(ph, destination=dest, attempt=attempt,
+                            outcome="ok")
             self.breaker.record_success()
             reg.incr(dest, "success")
             return out
@@ -781,9 +783,17 @@ class ResilientForwarder:
         jrn = self._journal
         if jrn is None:
             return
+        sc = _current_scope()
+        tick = sc.tick if sc is not None else None
+        ph = -1 if tick is None else tick.start("journal." + method,
+                                                sc.parent)
         try:
             getattr(jrn, method)(*args)
+            if tick is not None:
+                tick.finish(ph)
         except Exception:
+            if tick is not None:
+                tick.finish(ph, outcome="error")
             self._journal = None
             self.registry.incr(self.destination,
                                "durability.journal_errors")
@@ -991,6 +1001,12 @@ class ResilientForwarder:
             entry = self._entries[0]
             env = ForwardEnvelope(self.sender_id, entry.seq,
                                   entry.chunk_offset, entry.chunk_count)
+            sc = _current_scope()
+            tick = sc.tick if sc is not None else None
+            rp = -1 if tick is None else \
+                tick.start("forward.replay", sc.parent)
+            if tick is not None:
+                tick.annotate(rp, seq=entry.seq)
             try:
                 self._send(entry.export, env)
             except PartialDeliveryError as e:
@@ -998,12 +1014,18 @@ class ResilientForwarder:
                 entry.chunk_offset += e.delivered_chunks
                 if e.chunk_count:
                     entry.chunk_count = e.chunk_count
+                if tick is not None:
+                    tick.finish(rp, outcome="partial")
                 self._jop("update", entry.seq, entry.chunk_offset,
                           entry.chunk_count, entry.export)
                 replay_err = e
             except Exception as e:
+                if tick is not None:
+                    tick.finish(rp, outcome=type(e).__name__)
                 replay_err = e
             else:
+                if tick is not None:
+                    tick.finish(rp, outcome="ok")
                 reg.incr(dest, "replayed", _export_size(entry.export))
                 self._entries.pop(0)
                 self._jop("done", entry.seq)
@@ -1044,6 +1066,12 @@ class ResilientForwarder:
             # the spill merge changed the written-ahead payload
             self._jop("update", cur_seq, 0, 0, export)
         seq = cur_seq
+        sc = _current_scope()
+        tick = sc.tick if sc is not None else None
+        sp = -1 if tick is None else tick.start("forward.send",
+                                                sc.parent)
+        if tick is not None:
+            tick.annotate(sp, seq=seq)
         try:
             self._send(export, ForwardEnvelope(self.sender_id, seq))
         except PartialDeliveryError as e:
@@ -1051,6 +1079,8 @@ class ResilientForwarder:
             # the failed chunk's id. The UPDATE record goes first so
             # recovery shrinks the written-ahead payload to the
             # undelivered tail BEFORE any demote the park may trigger.
+            if tick is not None:
+                tick.finish(sp, outcome="partial")
             self._jop("update", seq, e.delivered_chunks, e.chunk_count,
                       e.undelivered)
             n = self._park(seq, e.undelivered,
@@ -1063,7 +1093,9 @@ class ResilientForwarder:
                 "sketches parked for replay under their original "
                 "envelope", dest, n)
             raise
-        except Exception:
+        except Exception as e:
+            if tick is not None:
+                tick.finish(sp, outcome=type(e).__name__)
             n = self._park(seq, export)
             self._age_entries()
             self._jop("age")
@@ -1072,7 +1104,35 @@ class ResilientForwarder:
                 "under their original envelope", dest, n)
             raise
         else:
+            if tick is not None:
+                tick.finish(sp, outcome="ok")
             self._jop("done", seq)
+
+    def debug_state(self) -> dict:
+        """JSON-ready ladder/spill/journal/breaker state for the
+        /debug/flush introspection endpoint. Reads only (flusher-thread
+        sizes may be one tick stale from another thread — fine for a
+        debug surface)."""
+        egress = (getattr(self.inner, "egress", None)
+                  or getattr(self.inner, "_egress", None))
+        breaker = getattr(egress, "breaker", None)
+        jrn = self._journal
+        return {
+            "destination": self.destination,
+            "sender_id": self.sender_id,
+            "next_seq": self._next_seq,
+            "ladder": [{"seq": e.seq, "age": e.age,
+                        "chunk_offset": e.chunk_offset,
+                        "chunk_count": e.chunk_count,
+                        "sketches": _export_size(e.export)}
+                       for e in self._entries],
+            "spill_sketches": len(self.spill),
+            "pending_spill": self.pending_spill,
+            "breaker_state": (None if breaker is None
+                              else breaker.state),
+            "journal": (None if jrn is None else {
+                "bytes": jrn.size_bytes()}),
+        }
 
     def close(self):
         if self._journal is not None:

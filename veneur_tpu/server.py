@@ -28,8 +28,7 @@ import socket
 import threading
 import time
 
-from . import resilience
-from . import trace as trace_mod
+from . import observe, resilience
 from .config import Config, _parse_interval
 from .ingest import parser
 from .metrics import FrameSet, InterMetric, MetricType
@@ -93,6 +92,16 @@ class Server:
         self.native_pump = None
         if cfg.native_ingest:
             self._setup_native_ingest()
+        # Unified telemetry spine (observe/registry.py): every counter
+        # this server owns — ingest, span pipeline, flush, sinks —
+        # lives in a PER-SERVER registry (two servers in one process,
+        # the chaos-harness topology, must never cross-count), while
+        # egress/durability objects keep counting into the process
+        # DEFAULT_REGISTRY; _self_metrics drains both. The historical
+        # counter attributes (packets_received, ...) remain as
+        # read-only properties over the registry. Built before the
+        # sinks: the Prometheus scrape surface captures it.
+        self.telemetry = observe.TelemetryRegistry()
         # one shared egress policy (retry/breaker knobs) for every
         # config-built sink and forwarder; per-destination breakers are
         # created inside each Egress
@@ -252,15 +261,13 @@ class Server:
         if cfg.sentry_dsn:
             from .utils.sentry import SentryClient
             self._sentry = SentryClient(cfg.sentry_dsn)
-        # per-sink flush stats from the previous interval
-        self._sink_stats: dict[str, tuple[int, float]] = {}
-        self._sink_stats_lock = threading.Lock()
-        # in-flight fan-out threads (flusher-thread-only) + skip counts:
-        # a sink whose previous flush is still running skips the interval
-        # instead of delaying the tick (flusher.go's per-sink goroutines
-        # never block the ticker).
+        # in-flight fan-out threads (flusher-thread-only): a sink whose
+        # previous flush is still running skips the interval instead of
+        # delaying the tick (flusher.go's per-sink goroutines never
+        # block the ticker). Per-sink flush stats/skips now ride the
+        # telemetry registry (scope "sink:<name>") and drain next
+        # interval like every other counter.
         self._sink_inflight: dict[tuple, threading.Thread] = {}
-        self._sink_skips: dict[tuple, int] = {}   # (kind, name) -> n
 
         self._threads: list[threading.Thread] = []
         self._sockets: list[socket.socket] = []
@@ -269,15 +276,18 @@ class Server:
         self._conns_lock = threading.Lock()
         self._stop = threading.Event()
         self._last_flush_ok = time.monotonic()
-        self.flush_count = 0
-        # self-telemetry counters (veneur.* names at flush)
-        self.packets_received = 0
-        self.parse_errors = 0
-        self.queue_drops = 0
-        self.spans_received = 0
-        self.ssf_errors = 0
-        self.flush_errors = 0
-        self.import_rejected = 0
+        # Flight recorder: the bounded ring of per-tick phase trees
+        # behind /debug/flush, SSF self-tracing, and the
+        # veneur.flush.phase.* dogfood timers. Strictly process-local.
+        self.flight = None
+        if cfg.flight_recorder:
+            self.flight = observe.FlightRecorder(
+                capacity=cfg.flight_recorder_ticks,
+                max_phases=cfg.flight_recorder_max_phases)
+        # on-demand jax.profiler capture around flush ticks (see
+        # _maybe_profile); written under _stats_lock
+        self._profile_ticks = 0
+        self._profile_active = False
         self._last_forward_err = None   # sentry dedupe, under _stats_lock
         self._stats_lock = threading.Lock()
         # SSF span pipeline (SpanWorker + SpanSinks)
@@ -305,6 +315,50 @@ class Server:
             timer_name = self.span_sinks[0]._timer_name
             if timer_name:
                 self.native_bridge.set_indicator_timer(timer_name)
+
+    # ------------- telemetry accessors (registry-backed) -------------
+    # The historical counter attributes, preserved as read-only views
+    # over the unified registry: interval-delta (reset at each flush's
+    # drain), exactly like the attribute counters they replace.
+
+    def _peek(self, name: str) -> int:
+        return self.telemetry.peek(observe.SERVER_SCOPE, name)
+
+    def _count(self, name: str, n: int = 1):
+        self.telemetry.incr(observe.SERVER_SCOPE, name, n)
+
+    @property
+    def packets_received(self) -> int:
+        return self._peek("packet.received")
+
+    @property
+    def parse_errors(self) -> int:
+        return self._peek("packet.error")
+
+    @property
+    def queue_drops(self) -> int:
+        return self._peek("worker.dropped")
+
+    @property
+    def spans_received(self) -> int:
+        return self._peek("ssf.received")
+
+    @property
+    def ssf_errors(self) -> int:
+        return self._peek("ssf.error")
+
+    @property
+    def flush_errors(self) -> int:
+        return self._peek("flush.error")
+
+    @property
+    def import_rejected(self) -> int:
+        return self._peek("import.rejected")
+
+    @property
+    def flush_count(self) -> int:
+        """Completed flush ticks since start (a level: never drained)."""
+        return self.telemetry.level(observe.SERVER_SCOPE, "flush.count")
 
     # ------------- construction helpers -------------
 
@@ -340,8 +394,7 @@ class Server:
             try:
                 item = parser.parse_packet(line, self._exclude_tags)
             except parser.ParseError:
-                with self._stats_lock:
-                    self.parse_errors += 1
+                self._count("packet.error")
                 return
             self._route_metric(item)
 
@@ -352,8 +405,7 @@ class Server:
             try:
                 span = framing.parse_ssf_datagram(payload)
             except framing.FramingError:
-                with self._stats_lock:
-                    self.ssf_errors += 1
+                self._count("ssf.error")
                 return
             self.handle_ssf_span(span)
 
@@ -405,7 +457,12 @@ class Server:
         if cfg.prometheus_repeater_address:
             from .sinks.prometheus import PrometheusMetricSink
             out.append(PrometheusMetricSink(
-                listen_address=cfg.prometheus_repeater_address))
+                listen_address=cfg.prometheus_repeater_address,
+                # one scrape surface for ALL veneur.* self-metrics:
+                # this server's telemetry spine + the process-default
+                # egress/durability registry
+                registries=(self.telemetry,
+                            resilience.DEFAULT_REGISTRY)))
         if cfg.debug:
             out.append(DebugMetricSink())
         if not out:
@@ -730,8 +787,7 @@ class Server:
                 try:
                     conn = ssl_ctx.wrap_socket(conn, server_side=True)
                 except Exception:
-                    with self._stats_lock:
-                        self.parse_errors += 1
+                    self._count("packet.error")
                     try:
                         conn.close()
                     except OSError:
@@ -777,16 +833,14 @@ class Server:
                         if len(tail) > max_len:
                             # oversized garbage line: drop, count, and
                             # swallow the rest of it
-                            with self._stats_lock:
-                                self.parse_errors += 1
+                            self._count("packet.error")
                             tail = b""
                             discarding = True
                         continue
                     self.handle_packet(buf[:nl])
                     tail = buf[nl + 1:]
                     if len(tail) > max_len:
-                        with self._stats_lock:
-                            self.parse_errors += 1
+                        self._count("packet.error")
                         tail = b""
                         discarding = True
         finally:
@@ -862,15 +916,13 @@ class Server:
                     # double-report the same span.
                     continue
                 if rc < 0:
-                    with self._stats_lock:
-                        self.ssf_errors += 1
+                    self._count("ssf.error")
                     continue
                 # rc == 0: STATUS samples present — Python path below
             try:
                 span = framing.parse_ssf_datagram(data)
             except framing.FramingError:
-                with self._stats_lock:
-                    self.ssf_errors += 1
+                self._count("ssf.error")
                 continue
             self.handle_ssf_span(span)
 
@@ -904,13 +956,11 @@ class Server:
                                 # counted via the bridge's ssf_spans
                                 continue
                             if rc < 0:
-                                with self._stats_lock:
-                                    self.ssf_errors += 1
+                                self._count("ssf.error")
                                 return
                         span = framing.parse_ssf_datagram(payload)
                     except (framing.FramingError, EOFError, OSError):
-                        with self._stats_lock:
-                            self.ssf_errors += 1
+                        self._count("ssf.error")
                         return
                     self.handle_ssf_span(span)
         finally:
@@ -923,12 +973,10 @@ class Server:
         try:
             self.span_queue.put_nowait(span)
         except queue.Full:
-            with self._stats_lock:
-                self.queue_drops += 1
+            self._count("worker.dropped")
         # counted after the enqueue so a waiter that observes the count
         # and then drain()s cannot race ahead of the item
-        with self._stats_lock:
-            self.spans_received += 1
+        self._count("ssf.received")
 
     def _span_worker(self):
         """SpanWorker: fan each span out to every span sink."""
@@ -957,8 +1005,7 @@ class Server:
         try:
             self.worker_queues[qi].put_nowait(item)
         except queue.Full:
-            with self._stats_lock:
-                self.queue_drops += 1
+            self._count("worker.dropped")
 
     def _start_import_listener(self, addr: str):
         """Global-mode gRPC receive path (importsrv): forwarded metrics
@@ -971,8 +1018,7 @@ class Server:
             try:
                 self.worker_queues[digest % nq].put_nowait(imported)
             except queue.Full:
-                with self._stats_lock:
-                    self.queue_drops += 1
+                self._count("worker.dropped")
 
         server, port = start_import_server(
             addr, submit, ledger=self.dedupe_ledger)
@@ -993,11 +1039,15 @@ class Server:
                 self.worker_queues[digest % nq].put_nowait(
                     ImportedMetric(pb))
             except queue.Full:
-                with self._stats_lock:
-                    self.queue_drops += 1
+                self._count("worker.dropped")
 
-        self.http_api = HttpApi(addr, submit=submit,
-                                ledger=self.dedupe_ledger)
+        self.http_api = HttpApi(
+            addr, submit=submit, ledger=self.dedupe_ledger,
+            debug_state=self._debug_flush_state,
+            # the profiler trigger only exists when the operator opted
+            # in via debug_flush_profile (a capture is a debug action)
+            profile=(self.request_profile_capture
+                     if self.cfg.debug_flush_profile else None))
         self.http_api.start()
 
     def bound_port(self) -> int:
@@ -1029,14 +1079,12 @@ class Server:
             try:
                 item = parser.parse_packet(line, self._exclude_tags)
             except parser.ParseError:
-                with self._stats_lock:
-                    self.parse_errors += 1
+                self._count("packet.error")
                 continue
             self._route_metric(item)
         # counted after routing so a waiter that observes the count and
         # then drain()s cannot race ahead of the lines
-        with self._stats_lock:
-            self.packets_received += 1
+        self._count("packet.received")
 
     def _worker_loop(self, idx: int, q: queue.Queue):
         """[HOT LOOP 2] queue -> engine (Worker.Work +
@@ -1061,8 +1109,7 @@ class Server:
                     try:
                         apply_metric_to_engine(eng, item.pb)
                     except Exception as e:
-                        with self._stats_lock:
-                            self.import_rejected += 1
+                        self._count("import.rejected")
                         log.warning(
                             "rejected corrupted imported metric "
                             "%r: %s", getattr(item.pb, "name", "?"), e)
@@ -1112,8 +1159,7 @@ class Server:
                 self._last_flush_ok = time.monotonic()
             except Exception as e:
                 log.exception("flush failed")
-                with self._stats_lock:
-                    self.flush_errors += 1
+                self._count("flush.error")
                 if self._sentry is not None:
                     self._sentry.capture(e, "flush failed")
 
@@ -1122,59 +1168,134 @@ class Server:
         (Server.Flush). Returns the flush's FrameSet — iterable of
         InterMetrics; frame-native consumers read .frames directly and
         InterMetric objects are only ever built lazily, inside whichever
-        sink thread first needs them."""
+        sink thread first needs them.
+
+        With the flight recorder on, the tick's phase tree (engine
+        drain / device dispatch / device exec / materialize / per-sink
+        fan-out / forward ladder / durability ops) lands in the ring
+        behind /debug/flush, replays as an SSF span tree through the
+        server's own trace client (flusher.go self-tracing parity), and
+        its top-level durations are re-ingested as LOCAL-ONLY
+        veneur.flush.phase.* timers — the engine serving percentiles of
+        its own flush."""
         t0 = time.monotonic()
         ts = int(timestamp if timestamp is not None else time.time())
+        tick = token = None
+        if self.flight is not None:
+            tick = self.flight.begin_tick(ts)
+            token = observe.set_current_tick(tick)
+        self._maybe_profile_start()
+        try:
+            if tick is None and self.trace_client is not None:
+                # flight_recorder: false must not silence the flush
+                # self-trace entirely — emit the root veneur.flush
+                # span the pre-recorder wrapper always produced (the
+                # per-phase children do require the recorder)
+                from . import trace as trace_mod
+                from .observe.registry import flush_span_name
+                with trace_mod.start_span(self.trace_client,
+                                          flush_span_name(),
+                                          service="veneur"):
+                    frameset = self._flush_tick(ts, t0, tick)
+            else:
+                frameset = self._flush_tick(ts, t0, tick)
+        finally:
+            # a failing (or killed — SimulatedKill/SIGKILL chaos) tick
+            # still closes its record: the ring is process-local state
+            # with no journal interaction, so a crash can never leave
+            # it half-written for the next incarnation
+            if token is not None:
+                observe.reset_current_tick(token)
+            if tick is not None:
+                self.flight.end_tick(tick)
+                if self.trace_client is not None:
+                    self.flight.emit_spans(tick, self.trace_client)
+            self._maybe_profile_stop()
+        if tick is not None and self.cfg.flush_phase_timers:
+            # dogfood loop: the NEXT tick's flush serves percentiles of
+            # THIS tick's phases, flushed like any tenant metric
+            for m in observe.phase_timer_samples(tick):
+                self._route_metric(m)
+        self.telemetry.incr_level(observe.SERVER_SCOPE, "flush.count")
+        return frameset
+
+    def _flush_tick(self, ts: int, t0: float, tick):
+        """The tick body (split from flush_once so recorder lifecycle
+        wraps it exactly once). `tick` is the TickRecord or None."""
         frames = []
         merged_export = ForwardExport()
         events, checks = [], []
-        with trace_mod.start_span(self.trace_client, "veneur.flush",
-                                   service="veneur"):
-            status_metrics = []
-            eng_stats = {"samples": 0, "dropped_no_slot": 0,
-                         "swap_ns": 0, "merge_ns": 0, "assembly_ns": 0}
-            # Engines flush concurrently so their device→host transfers
-            # overlap: on the tunneled backend each device_get pays a
-            # ~65-90ms wire floor, and N engines in sequence pay it N
-            # times; in parallel they pay ~1×. Single engine = no thread.
-            results: list = [None] * len(self.engines)
-            if len(self.engines) == 1:
-                results[0] = self.engines[0].flush(timestamp=ts)
-            else:
-                def _one(i, eng):
-                    try:
-                        results[i] = eng.flush(timestamp=ts)
-                    except BaseException as e:
-                        results[i] = e
-                ths = [threading.Thread(target=_one, args=(i, eng),
-                                        daemon=True,
-                                        name=f"engine-flush-{i}")
-                       for i, eng in enumerate(self.engines)]
-                for t in ths:
-                    t.start()
-                for t in ths:
-                    t.join()
-            for eng, res in zip(self.engines, results):
-                if isinstance(res, BaseException):
-                    raise res
-                if res is None:   # a flush thread died; surface it
-                    raise RuntimeError("engine flush failed")
-                for k in eng_stats:
-                    eng_stats[k] += res.stats.get(k, 0)
-                frames.append(res.frame)
-                status_metrics.extend(res.status_metrics)
-                merged_export.histograms.extend(res.export.histograms)
-                merged_export.sets.extend(res.export.sets)
-                merged_export.counters.extend(res.export.counters)
-                merged_export.gauges.extend(res.export.gauges)
-                ev, ch = eng.drain_events()
-                events.extend(ev)
-                checks.extend(ch)
+        status_metrics = []
+        eng_stats = {"samples": 0, "dropped_no_slot": 0,
+                     "swap_ns": 0, "merge_ns": 0, "assembly_ns": 0}
+        # Engines flush concurrently so their device→host transfers
+        # overlap: on the tunneled backend each device_get pays a
+        # ~65-90ms wire floor, and N engines in sequence pay it N
+        # times; in parallel they pay ~1×. Single engine = no thread.
+        results: list = [None] * len(self.engines)
+        eng_ph: list = [-1] * len(self.engines)
+        ep = -1 if tick is None else tick.start("engine")
+        if len(self.engines) == 1:
+            eng_ph[0] = -1 if tick is None else \
+                tick.start("engine.flush", ep)
+            results[0] = self.engines[0].flush(timestamp=ts)
+            if tick is not None:
+                tick.finish(eng_ph[0], engine=0)
+        else:
+            def _one(i, eng):
+                ph = -1 if tick is None else \
+                    tick.start("engine.flush", ep)
+                eng_ph[i] = ph
+                try:
+                    results[i] = eng.flush(timestamp=ts)
+                except BaseException as e:
+                    results[i] = e
+                finally:
+                    if tick is not None:
+                        tick.finish(ph, engine=i)
+            ths = [threading.Thread(target=_one, args=(i, eng),
+                                    daemon=True,
+                                    name=f"engine-flush-{i}")
+                   for i, eng in enumerate(self.engines)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+        for i, (eng, res) in enumerate(zip(self.engines, results)):
+            if isinstance(res, BaseException):
+                raise res
+            if res is None:   # a flush thread died; surface it
+                raise RuntimeError("engine flush failed")
+            for k in eng_stats:
+                eng_stats[k] += res.stats.get(k, 0)
+            if tick is not None:
+                # graft the engine's own stamps (drain / device
+                # dispatch / device exec / fetch / materialize) under
+                # its engine.flush phase, with their real edges
+                for nm, p0, p1 in res.stats.get("phases", ()):
+                    tick.add("engine." + nm, p0, p1, parent=eng_ph[i])
+            frames.append(res.frame)
+            status_metrics.extend(res.status_metrics)
+            merged_export.histograms.extend(res.export.histograms)
+            merged_export.sets.extend(res.export.sets)
+            merged_export.counters.extend(res.export.counters)
+            merged_export.gauges.extend(res.export.gauges)
+            ev, ch = eng.drain_events()
+            events.extend(ev)
+            checks.extend(ch)
+        if tick is not None:
+            tick.finish(ep)
 
+        tp = -1 if tick is None else tick.start("telemetry")
         frameset = FrameSet(
             frames,
             status_metrics + self._self_metrics(ts, t0, eng_stats))
-        self._fan_out(frameset, events, checks)
+        if tick is not None:
+            tick.finish(tp)
+        fo = -1 if tick is None else tick.start("fanout")
+        self._fan_out(frameset, events, checks, tick=tick, parent=fo)
+        if tick is not None:
+            tick.finish(fo)
 
         # forward when the interval produced exports OR earlier spilled
         # sketches await re-merge — an idle interval must still retry a
@@ -1183,11 +1304,13 @@ class Server:
                 merged_export.histograms or merged_export.sets
                 or merged_export.counters or merged_export.gauges
                 or getattr(self.forwarder, "pending_spill", 0)):
+            fw = -1 if tick is None else tick.start("forward")
+            # re-scope the contextvar so the ladder's attempt/replay/
+            # journal phases nest under `forward`, not beside it
+            ftok = observe.set_current_tick(tick, fw) \
+                if tick is not None else None
             try:
-                with trace_mod.start_span(self.trace_client,
-                                          "veneur.flush.forward",
-                                          service="veneur"):
-                    self.forwarder(merged_export)
+                self.forwarder(merged_export)
                 with self._stats_lock:
                     self._last_forward_err = None
             except Exception as e:
@@ -1202,68 +1325,170 @@ class Server:
                     self._last_forward_err = sig
                 if self._sentry is not None and not repeat:
                     self._sentry.capture(e, "forward failed")
+            finally:
+                if ftok is not None:
+                    observe.reset_current_tick(ftok)
+                if tick is not None:
+                    tick.finish(fw)
         # durability flush boundary: fsync + compact the forward
         # journal, and record the dedupe ledger's per-sender admitted
         # watermarks (everything admitted up to here rides in flushed
         # state no later than the NEXT tick — the one-interval fuzz is
         # documented in README "Durable state")
-        if self._forward_journal is not None:
-            tick = getattr(self.forwarder, "journal_tick", None)
-            if tick is not None:
-                tick()   # journal failures degrade inside the forwarder
-        if self._dedupe_journal is not None and \
-                self.dedupe_ledger is not None:
-            try:
-                # record LAST tick's snapshot, capture this tick's: a
-                # seq admitted during this tick may not be in the state
-                # this tick flushed (worker-queue residency), so it
-                # only becomes a durable floor once a full interval has
-                # carried it into a flush. A crash loses at most the
-                # watermark advance of the last two ticks — replays of
-                # those seqs re-admit, which the receiver-side dedupe
-                # ledger bounds exactly as before durability existed.
-                marks = self._pending_watermarks
-                # vlint: disable=TH01 reason=flush-path-only state;
-                # flushes are serialized (one flusher thread, tests
-                # call flush_once synchronously)
-                self._pending_watermarks = \
-                    self.dedupe_ledger.max_admitted()
-                self._dedupe_journal.record(marks)
-                self._dedupe_journal.sync()
-            except Exception:
-                # a failing disk must not fail the flush tick; the
-                # in-memory ledger keeps deduping, only crash-restart
-                # watermark durability degrades (counted, loud)
-                resilience.DEFAULT_REGISTRY.incr(
-                    "import", "durability.journal_errors")
-                log.exception(
-                    "dedupe watermark journal failed; DISABLING it "
-                    "for this process (in-memory dedupe unaffected)")
+        dp = -1
+        dtok = None
+        if tick is not None and (
+                self._forward_journal is not None
+                or (self._dedupe_journal is not None
+                    and self.dedupe_ledger is not None)):
+            dp = tick.start("durability")
+            dtok = observe.set_current_tick(tick, dp)
+        try:
+            if self._forward_journal is not None:
+                jt = getattr(self.forwarder, "journal_tick", None)
+                if jt is not None:
+                    jt()  # journal failures degrade inside the forwarder
+            if self._dedupe_journal is not None and \
+                    self.dedupe_ledger is not None:
                 try:
-                    self._dedupe_journal.close()
+                    # record LAST tick's snapshot, capture this tick's:
+                    # a seq admitted during this tick may not be in the
+                    # state this tick flushed (worker-queue residency),
+                    # so it only becomes a durable floor once a full
+                    # interval has carried it into a flush. A crash
+                    # loses at most the watermark advance of the last
+                    # two ticks — replays of those seqs re-admit, which
+                    # the receiver-side dedupe ledger bounds exactly as
+                    # before durability existed.
+                    marks = self._pending_watermarks
+                    # vlint: disable=TH01 reason=flush-path-only state;
+                    # flushes are serialized (one flusher thread, tests
+                    # call flush_once synchronously)
+                    self._pending_watermarks = \
+                        self.dedupe_ledger.max_admitted()
+                    self._dedupe_journal.record(marks)
+                    self._dedupe_journal.sync()
                 except Exception:
-                    pass
-                # vlint: disable=TH01 reason=flush-path-only state;
-                # flushes are serialized (one flusher thread, tests
-                # call flush_once synchronously) and stop() reads it
-                # only after the last tick ended
-                self._dedupe_journal = None
-        with self._stats_lock:
-            self.flush_count += 1
+                    # a failing disk must not fail the flush tick; the
+                    # in-memory ledger keeps deduping, only crash-restart
+                    # watermark durability degrades (counted, loud)
+                    resilience.DEFAULT_REGISTRY.incr(
+                        "import", "durability.journal_errors")
+                    log.exception(
+                        "dedupe watermark journal failed; DISABLING it "
+                        "for this process (in-memory dedupe unaffected)")
+                    try:
+                        self._dedupe_journal.close()
+                    except Exception:
+                        pass
+                    # vlint: disable=TH01 reason=flush-path-only state;
+                    # flushes are serialized (one flusher thread, tests
+                    # call flush_once synchronously) and stop() reads it
+                    # only after the last tick ended
+                    self._dedupe_journal = None
+        finally:
+            if dtok is not None:
+                observe.reset_current_tick(dtok)
+            if dp != -1:
+                tick.finish(dp)
         return frameset
+
+    # ------------- on-demand jax.profiler capture -------------
+    # GET /debug/flush/profile?ticks=N schedules a capture (gated by
+    # debug_flush_profile); the flusher starts the trace before the
+    # next tick and stops it after N ticks — the window
+    # capture_tpu_window.sh needs for TPU-live phase evidence.
+
+    def request_profile_capture(self, ticks: int = 1) -> dict:
+        ticks = max(1, int(ticks))
+        with self._stats_lock:
+            self._profile_ticks = max(self._profile_ticks, ticks)
+            pending = self._profile_ticks
+        return {"capture_ticks": pending,
+                "dir": self.cfg.debug_flush_profile_dir}
+
+    def _maybe_profile_start(self):
+        with self._stats_lock:
+            want = self._profile_ticks > 0 and not self._profile_active
+            if want:
+                self._profile_active = True
+        if not want:
+            return
+        try:
+            import jax
+            jax.profiler.start_trace(self.cfg.debug_flush_profile_dir)
+            log.info("debug/flush: jax profiler capture started -> %s",
+                     self.cfg.debug_flush_profile_dir)
+        except Exception as e:
+            log.warning("debug/flush: jax profiler unavailable: %s", e)
+            with self._stats_lock:
+                self._profile_active = False
+                self._profile_ticks = 0
+
+    def _maybe_profile_stop(self):
+        with self._stats_lock:
+            if not self._profile_active:
+                return
+            self._profile_ticks -= 1
+            done = self._profile_ticks <= 0
+        if not done:
+            return
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            log.info("debug/flush: jax profiler capture complete")
+        except Exception as e:
+            log.warning("debug/flush: profiler stop failed: %s", e)
+        with self._stats_lock:
+            self._profile_active = False
+
+    def _debug_flush_state(self) -> dict:
+        """GET /debug/flush payload: the flight-recorder ring plus the
+        breaker/ladder/journal/dedupe-ledger state a flush-latency
+        investigation needs next (schema in README 'Observability')."""
+        fwd = self.forwarder
+        state = {
+            "flush_count": self.flush_count,
+            "flight_recorder": (None if self.flight is None
+                                else self.flight.debug_state()),
+            "forward": (fwd.debug_state()
+                        if hasattr(fwd, "debug_state") else None),
+            "dedupe_ledger": None,
+            "durability": {
+                "forward_journal_bytes": (
+                    self._forward_journal.size_bytes()
+                    if self._forward_journal is not None else None),
+                "watermark_journal_bytes": (
+                    self._dedupe_journal.size_bytes()
+                    if self._dedupe_journal is not None else None),
+            },
+            "registry": {
+                "server": self.telemetry.debug_state(),
+                "process": resilience.DEFAULT_REGISTRY.debug_state(),
+            },
+        }
+        if self.dedupe_ledger is not None:
+            state["dedupe_ledger"] = {
+                "size": self.dedupe_ledger.size(),
+                "senders": self.dedupe_ledger.sender_count(),
+                "watermarks": self.dedupe_ledger.max_admitted(),
+            }
+        return state
 
     def _self_metrics(self, ts: int, t0: float,
                       eng_stats: dict | None = None) -> list[InterMetric]:
-        """veneur.* self-telemetry (the internal statsd client's names,
-        incl. the reference's flush.*_duration_ns phase breakdown)."""
-        with self._stats_lock:
-            packets, self.packets_received = self.packets_received, 0
-            perrs, self.parse_errors = self.parse_errors, 0
-            drops, self.queue_drops = self.queue_drops, 0
-            spans, self.spans_received = self.spans_received, 0
-            sserrs, self.ssf_errors = self.ssf_errors, 0
-            flerrs, self.flush_errors = self.flush_errors, 0
-            imprej, self.import_rejected = self.import_rejected, 0
+        """veneur.* self-telemetry: stage the per-tick gauges/deltas
+        into the unified registry, then drain BOTH registries — this
+        server's spine and the process-default egress/durability one —
+        through the single name mapping in observe/registry.py (the
+        internal statsd client's names, unchanged)."""
+        tel, S = self.telemetry, observe.SERVER_SCOPE
+        # the core counters report every interval, zeros included, as
+        # the pre-unification attribute drain always did
+        for name in ("packet.received", "packet.error", "worker.dropped",
+                     "ssf.received", "ssf.error", "flush.error",
+                     "import.rejected"):
+            tel.mark(S, name, 0)
         if self.native_bridge is not None:
             # UDP in native mode is counted in the bridge; fold in the
             # per-interval deltas. Drop taxonomy: ring/backpressure
@@ -1274,16 +1499,21 @@ class Server:
             # double-report).
             st = self.native_bridge.stats()
             last = getattr(self, "_last_bridge_stats", None) or {}
-            packets += int(st["packets"]) - int(last.get("packets", 0))
-            perrs += int(st["parse_errors"]) - int(
-                last.get("parse_errors", 0))
-            drops += (int(st["ring_drops"])
-                      - int(last.get("ring_drops", 0)))
+            tel.incr(S, "packet.received",
+                     int(st["packets"]) - int(last.get("packets", 0)))
+            tel.incr(S, "packet.error",
+                     int(st["parse_errors"])
+                     - int(last.get("parse_errors", 0)))
+            tel.incr(S, "worker.dropped",
+                     int(st["ring_drops"])
+                     - int(last.get("ring_drops", 0)))
             # natively-decoded spans + their decode errors (fallback
             # datagrams re-enter the Python path and are counted there)
-            spans += int(st["ssf_spans"]) - int(last.get("ssf_spans", 0))
-            sserrs += (int(st["ssf_errors"])
-                       - int(last.get("ssf_errors", 0)))
+            tel.incr(S, "ssf.received",
+                     int(st["ssf_spans"]) - int(last.get("ssf_spans", 0)))
+            tel.incr(S, "ssf.error",
+                     int(st["ssf_errors"])
+                     - int(last.get("ssf_errors", 0)))
             if eng_stats is not None:
                 eng_stats["dropped_no_slot"] = (
                     int(st["drops_no_slot"])
@@ -1292,69 +1522,32 @@ class Server:
             # are serialized (one flusher thread, tests call flush_once
             # synchronously), so no concurrent writer exists
             self._last_bridge_stats = st
-        dur_ns = (time.monotonic() - t0) * 1e9
-        mk = lambda name, value, mt, tags=(): InterMetric(
-            name=name, timestamp=ts, value=value, tags=list(tags),
-            type=mt, hostname=self.hostname)
-        out = [
-            mk("veneur.packet.received_total", packets, MetricType.COUNTER),
-            mk("veneur.packet.error_total", perrs, MetricType.COUNTER),
-            mk("veneur.worker.dropped_total", drops, MetricType.COUNTER),
-            mk("veneur.ssf.received_total", spans, MetricType.COUNTER),
-            mk("veneur.ssf.error_total", sserrs, MetricType.COUNTER),
-            mk("veneur.flush.total_duration_ns", dur_ns, MetricType.GAUGE),
-            mk("veneur.flush.error_total", flerrs, MetricType.COUNTER),
-            mk("veneur.import.rejected_total", imprej,
-               MetricType.COUNTER),
-        ]
+        tel.set_gauge(S, "flush.total_duration_ns",
+                      (time.monotonic() - t0) * 1e9)
         if self.dedupe_ledger is not None:
-            out.append(mk("veneur.forward.dedupe_ledger_size",
-                          self.dedupe_ledger.size(), MetricType.GAUGE))
+            tel.set_gauge(S, "forward.dedupe_ledger_size",
+                          self.dedupe_ledger.size())
         journals = [j for j in (self._forward_journal,
                                 self._dedupe_journal) if j is not None]
         if journals:
             # counters (journal_appends/truncated_frames/recovered_*)
-            # ride the registry drain below; the level-style metrics
-            # are gauges and come straight from the journals
-            out.append(mk("veneur.durability.journal_bytes",
-                          sum(j.size_bytes() for j in journals),
-                          MetricType.GAUGE))
-            out.append(mk(
-                "veneur.durability.snapshot_duration_ns",
-                max(j.journal.last_snapshot_ns for j in journals),
-                MetricType.GAUGE))
+            # ride the process registry's drain below; the level-style
+            # metrics are gauges and come straight from the journals
+            tel.set_gauge(S, "durability.journal_bytes",
+                          sum(j.size_bytes() for j in journals))
+            tel.set_gauge(S, "durability.snapshot_duration_ns",
+                          max(j.journal.last_snapshot_ns
+                              for j in journals))
         if eng_stats is not None:
-            out += [
-                mk("veneur.samples.processed_total",
-                   eng_stats["samples"], MetricType.COUNTER),
-                mk("veneur.samples.dropped_no_slot_total",
-                   eng_stats["dropped_no_slot"], MetricType.COUNTER),
-                mk("veneur.flush.swap_duration_ns",
-                   eng_stats["swap_ns"], MetricType.GAUGE),
-                mk("veneur.flush.merge_duration_ns",
-                   eng_stats["merge_ns"], MetricType.GAUGE),
-                mk("veneur.flush.assembly_duration_ns",
-                   eng_stats["assembly_ns"], MetricType.GAUGE),
-            ]
-        # per-sink counts/durations from the PREVIOUS interval's fan-out
-        # (the sinks for this interval haven't run yet) — flusher.go's
-        # per-sink flush spans / sink.flushed_metrics self-metrics.
-        with self._sink_stats_lock:
-            sink_stats, self._sink_stats = self._sink_stats, {}
-            sink_skips, self._sink_skips = self._sink_skips, {}
-        for name, (count, ns, errs) in sorted(sink_stats.items()):
-            tags = [f"sink:{name}"]
-            out.append(mk("veneur.sink.metrics_flushed_total", count,
-                          MetricType.COUNTER, tags))
-            out.append(mk("veneur.sink.flush_duration_ns", ns,
-                          MetricType.GAUGE, tags))
-            out.append(mk("veneur.sink.flush_errors_total", errs,
-                          MetricType.COUNTER, tags))
-        for (kind, name), skips in sorted(sink_skips.items()):
-            # tagged by component kind so a wedged plugin named like a
-            # sink doesn't masquerade as that sink in the skip counter
-            out.append(mk("veneur.sink.flush_skipped_total", skips,
-                          MetricType.COUNTER, [f"{kind}:{name}"]))
+            tel.mark(S, "samples.processed", eng_stats["samples"])
+            tel.mark(S, "samples.dropped_no_slot",
+                     eng_stats["dropped_no_slot"])
+            tel.set_gauge(S, "flush.swap_duration_ns",
+                          eng_stats["swap_ns"])
+            tel.set_gauge(S, "flush.merge_duration_ns",
+                          eng_stats["merge_ns"])
+            tel.set_gauge(S, "flush.assembly_duration_ns",
+                          eng_stats["assembly_ns"])
         # ---- drop taxonomy ----
         # Losses are counted exactly once, at the layer that owns them:
         #   veneur.worker.dropped_total          ingest backpressure —
@@ -1372,15 +1565,15 @@ class Server:
         #     failed forward's sketches are spilled, then re-merged
         #     into the next interval's forward (lossless), and only
         #     spill_evicted_total (budget/gauge-age eviction) is loss.
-        for (dest, cname), v in sorted(
-                resilience.DEFAULT_REGISTRY.take().items()):
-            # dotted counter names carry their own namespace (the
-            # import path's "forward.duplicates_dropped" /
-            # "import.rejected" land under veneur.<name>_total);
-            # plain names are the egress layer's veneur.resilience.*
-            prefix = "veneur." if "." in cname else "veneur.resilience."
-            out.append(mk(f"{prefix}{cname}_total", v,
-                          MetricType.COUNTER, [f"destination:{dest}"]))
+        #
+        # Per-sink counts/durations drain from the PREVIOUS interval's
+        # fan-out (this interval's sinks haven't run yet) — the sink
+        # threads recorded them into scope "sink:<name>" as they
+        # finished. Dotted counter names carry their own namespace;
+        # plain names are the egress layer's veneur.resilience.* — the
+        # mapping lives in observe/registry.py.
+        out = (tel.drain(ts, self.hostname)
+               + resilience.DEFAULT_REGISTRY.drain(ts, self.hostname))
         if self._stats_sock is not None:
             # scopedstatsd mode: ship veneur.* over the wire to
             # stats_address (usually this server's own statsd port)
@@ -1398,7 +1591,7 @@ class Server:
             return []
         return out
 
-    def _fan_out(self, frameset, events, checks):
+    def _fan_out(self, frameset, events, checks, tick=None, parent=-1):
         """Per-sink parallel flush, decoupled from the tick (one
         independent goroutine per sink in Server.Flush — the flusher
         NEVER joins them). Sinks receive the columnar FrameSet; legacy
@@ -1406,13 +1599,23 @@ class Server:
         (cached once, shared), frame-native sinks never do. A sink whose
         previous flush is still in flight skips this interval — counted
         as veneur.sink.flush_skipped_total — so one wedged vendor can't
-        push the next tick late or starve the other sinks."""
+        push the next tick late or starve the other sinks.
+
+        With a tick active, every sink/plugin/span-sink flush gets its
+        own phase under `fanout` (the sink threads hold explicit
+        handles); a sink still running when the flush tick ends shows
+        `in_flight` in /debug/flush — the wedged-vendor signature."""
+        tel = self.telemetry
+
         def spawn(key, target):
             prev = self._sink_inflight.get(key)
             if prev is not None and prev.is_alive():
-                with self._sink_stats_lock:
-                    self._sink_skips[key] = (
-                        self._sink_skips.get(key, 0) + 1)
+                # tagged by component kind so a wedged plugin named
+                # like a sink doesn't masquerade as that sink
+                tel.incr(f"{key[0]}:{key[1]}", "sink.flush_skipped")
+                if tick is not None:
+                    tick.finish(tick.start("sink.skip", parent),
+                                kind=key[0], name=key[1])
                 return
             t = threading.Thread(target=target, daemon=True,
                                  name=f"{key[0]}-{key[1]}")
@@ -1425,6 +1628,8 @@ class Server:
 
         for s in self.sinks:
             def run(sink=s):
+                ph = -1 if tick is None else \
+                    tick.start("sink.flush", parent)
                 t0 = time.monotonic()
                 ok = False
                 n = None
@@ -1436,7 +1641,7 @@ class Server:
                 except Exception:
                     log.exception("sink %s flush failed", sink.name())
                 finally:
-                    # reported in the NEXT interval's veneur.sink.*
+                    # drained in the NEXT interval's veneur.sink.*
                     # self-metrics (flusher.go per-sink spans); a failed
                     # flush reports 0 flushed + an error count, so a
                     # down vendor is visible, not masked. flush_frames
@@ -1445,25 +1650,43 @@ class Server:
                     count = 0
                     if ok:
                         count = n if isinstance(n, int) else len(frameset)
-                    with self._sink_stats_lock:
-                        self._sink_stats[sink.name()] = (
-                            count, (time.monotonic() - t0) * 1e9,
-                            0 if ok else 1)
+                    scope = f"sink:{sink.name()}"
+                    tel.mark(scope, "sink.metrics_flushed", count)
+                    tel.set_gauge(scope, "sink.flush_duration_ns",
+                                  (time.monotonic() - t0) * 1e9)
+                    tel.mark(scope, "sink.flush_errors", 0 if ok else 1)
+                    if tick is not None:
+                        tick.finish(ph, sink=sink.name(), ok=ok,
+                                    flushed=count)
             spawn(("sink", s.name()), run)
         for p in self.plugins:
             def runp(plugin=p):
+                ph = -1 if tick is None else \
+                    tick.start("plugin.flush", parent)
+                ok = True
                 try:
                     plugin.flush_frames(frameset, self.hostname)
                 except Exception:
+                    ok = False
                     log.exception("plugin %s flush failed", plugin.name())
+                finally:
+                    if tick is not None:
+                        tick.finish(ph, plugin=plugin.name(), ok=ok)
             spawn(("plugin", p.name()), runp)
         for ss in self.span_sinks:
             def runs(sink=ss):
+                ph = -1 if tick is None else \
+                    tick.start("spansink.flush", parent)
+                ok = True
                 try:
                     sink.flush()
                 except Exception:
+                    ok = False
                     log.exception("span sink %s flush failed",
                                   sink.name())
+                finally:
+                    if tick is not None:
+                        tick.finish(ph, sink=sink.name(), ok=ok)
             spawn(("spansink", ss.name()), runs)
 
     def _start_profiling(self):

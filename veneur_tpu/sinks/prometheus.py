@@ -104,9 +104,34 @@ class _TtlCounterTotals(dict):
             self.pop(k, None)
 
 
+def render_registries(registries, exclude_names=frozenset()) -> str:
+    """One scrape's view of the unified telemetry registries
+    (observe/registry.py): cumulative counters (native Prometheus
+    counter semantics — no delta reconstruction needed), live gauges,
+    and levels. Families already present in `exclude_names` (sanitized)
+    are skipped so a flush body that carries the same self-metrics
+    can't produce duplicate TYPE families in one exposition."""
+    import time as _time
+
+    ts = int(_time.time())
+    metrics = []
+    for reg in registries:
+        metrics.extend(m for m in reg.snapshot(ts)
+                       if sanitize_name(m.name) not in exclude_names)
+    # registry values are already cumulative: render without the
+    # flush-path counter_totals accumulator
+    return render(metrics, None)
+
+
 class PrometheusMetricSink(MetricSink):
+    """The exposition server. With `registries` (the server wires its
+    telemetry spine + the process default), /metrics is ONE scrape
+    surface for every veneur.* self-metric — including the counters
+    that would otherwise only be visible inside a flush body (or not at
+    all when stats_address diverts self-metrics onto the wire)."""
+
     def __init__(self, listen_address: str = "127.0.0.1:9125",
-                 counter_idle_flushes: int = 60):
+                 counter_idle_flushes: int = 60, registries=()):
         # parsed in start() so a malformed address disables this sink
         # (the server catches start() errors per-sink) instead of
         # aborting server construction
@@ -114,8 +139,10 @@ class PrometheusMetricSink(MetricSink):
         self.host = ""
         self.port = -1
         self._body = b""
+        self._body_names: frozenset = frozenset()
         self._lock = threading.Lock()
         self._counter_totals = _TtlCounterTotals(counter_idle_flushes)
+        self._registries = tuple(registries)
         self._server: ThreadingHTTPServer | None = None
 
     def name(self) -> str:
@@ -135,6 +162,12 @@ class PrometheusMetricSink(MetricSink):
                     return
                 with sink._lock:
                     body = sink._body
+                    names = sink._body_names
+                if sink._registries:
+                    # registry state renders at scrape time (fresh),
+                    # minus families the flush body already carries
+                    body = body + render_registries(
+                        sink._registries, names).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -155,6 +188,8 @@ class PrometheusMetricSink(MetricSink):
                    if m.type != MetricType.STATUS]  # datadog-shaped
         with self._lock:
             self._body = render(metrics, self._counter_totals).encode()
+            self._body_names = frozenset(
+                sanitize_name(m.name) for m in metrics)
             self._counter_totals.advance()
 
     def stop(self):
