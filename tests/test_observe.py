@@ -547,3 +547,55 @@ def test_default_registry_is_the_resilience_registry():
     from veneur_tpu import resilience
     assert resilience.DEFAULT_REGISTRY is DEFAULT_REGISTRY
     assert resilience.ResilienceRegistry is TelemetryRegistry
+
+
+def test_storm_tick_records_fold_phases_in_the_ring():
+    """ISSUE 7: a cardinality-storm tick shows its degradation IN the
+    flight-recorder ring — an `overload` phase carrying the governor's
+    rate, with an `overload.fold` child carrying the interval's fold
+    counts — right next to the phases explaining the tick's time, and
+    serialized through the same /debug/flush snapshot."""
+    cfg = read_config(text=_YAML + """
+statsd_listen_addresses: ["udp://127.0.0.1:0"]
+overload_defense_enabled: true
+overload_max_keys_per_prefix: 2
+flush_phase_timers: false
+""")
+    cap = CaptureMetricSink()
+    srv = Server(cfg, sinks=[cap], plugins=[], span_sinks=[])
+    srv.start()
+    try:
+        port = srv.bound_port()
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for k in range(12):              # 2 in budget, 10 folded
+            s.sendto(b"st.u%d:1|c" % k, ("127.0.0.1", port))
+        deadline = time.monotonic() + 5
+        while srv.packets_received < 12 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.drain(5)
+        srv.flush_once(timestamp=7)
+
+        tick = srv.flight.last_tick()
+        by_name = {}
+        for i, (name, t0, t1, parent) in enumerate(tick.phases()):
+            by_name[name] = (i, parent, t1 > 0)
+        assert "overload" in by_name and by_name["overload"][2]
+        ov_idx = by_name["overload"][0]
+        assert by_name["overload"][1] == -1          # top-level phase
+        assert by_name["overload.fold"][1] == ov_idx  # nested child
+        # meta rides the snapshot the /debug/flush endpoint serves
+        snap = tick.to_dict()
+        fold = next(p for p in snap["phases"]
+                    if p["name"] == "overload.fold")
+        assert fold["meta"]["folded"] == 10
+        ov = next(p for p in snap["phases"] if p["name"] == "overload")
+        assert ov["meta"]["rate"] == 1.0
+        assert ov["meta"]["overloaded"] is False
+        # a healthy (no-fold) tick records the governor phase alone
+        srv.flush_once(timestamp=8)
+        names = [p[0] for p in srv.flight.last_tick().phases()]
+        assert "overload" in names
+        assert "overload.fold" not in names
+        assert "overload.shed" not in names
+    finally:
+        srv.stop()

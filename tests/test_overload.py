@@ -1,0 +1,510 @@
+"""Overload-defense soak harness (ISSUE 7).
+
+Drives the three storm shapes production actually throws through a
+REAL config-built Server over REAL loopback UDP — template: the
+PR 2/4/5 scripted-fault + real-socket harnesses, no arbitrary sleeps
+(settling is Server.drain's queue accounting, flushes are synchronous
+flush_once calls):
+
+  1. tag-cardinality explosion — a bad deploy minting a unique tag per
+     request; bank slot minting must stay capped at the per-prefix
+     budget, over-budget keys folding into `<prefix>.__other__`.
+  2. hot-key skew — one metric absorbing the overwhelming share of
+     samples; ingest survives through the hot-slot sidestep with zero
+     degradation and exact totals.
+  3. sustained over-capacity — every flush tick overruns the interval;
+     the governor sheds whole packets at an adaptive rate and
+     rate-corrects survivors so flushed totals stay unbiased.
+
+Cross-cutting invariants, asserted per storm:
+  * bounded memory — bank slot count and admission/registry state are
+    capped at configured budgets under a >10x-cardinality storm;
+  * zero silent loss — the accounting identity
+    `received == applied + counted_degraded` holds EXACTLY;
+  * in-budget fidelity — percentiles of in-budget keys are
+    bit-identical to a no-storm oracle server fed the same traffic.
+
+`flush_phase_timers: false` in the harness configs: the dogfood
+veneur.flush.phase.* timers are engine samples too, and exact sample
+accounting wants only the test's own traffic in the banks.
+"""
+
+import json
+import random
+import socket
+import time
+import urllib.request
+
+from veneur_tpu import observe
+from veneur_tpu.config import read_config
+from veneur_tpu.ingest.parser import MetricKey, parse_metric
+from veneur_tpu.models.pipeline import AggregationEngine, EngineConfig
+from veneur_tpu.server import Server
+from veneur_tpu.sinks.basic import CaptureMetricSink
+
+S = observe.SERVER_SCOPE
+
+_BASE_CFG = """
+interval: "3600s"
+hostname: h
+statsd_listen_addresses: ["udp://127.0.0.1:0"]
+flush_phase_timers: false
+aggregates: ["min", "max", "count"]
+percentiles: [0.5, 0.75, 0.99]
+tpu_histogram_slots: 512
+tpu_counter_slots: 512
+tpu_gauge_slots: 128
+tpu_set_slots: 64
+tpu_batch_size: 8192
+tpu_buffer_depth: 256
+"""
+
+
+def _server(extra: str = "", defense: bool = True) -> tuple:
+    text = _BASE_CFG
+    if defense:
+        text += "overload_defense_enabled: true\n"
+    cfg = read_config(text=text + extra)
+    cap = CaptureMetricSink()
+    srv = Server(cfg, sinks=[cap], plugins=[], span_sinks=[])
+    srv.start()
+    return srv, cap
+
+
+def _send(srv: Server, lines: list[bytes], already: int = 0) -> int:
+    """One datagram per line (so packet accounting == line accounting),
+    settled via the telemetry counters + queue drain — no sleeps."""
+    port = srv.bound_port()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for ln in lines:
+            sock.sendto(ln, ("127.0.0.1", port))
+        want = already + len(lines)
+        deadline = time.monotonic() + 20
+        while (srv.telemetry.total(S, "packet.received") < want
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert srv.telemetry.total(S, "packet.received") == want, \
+            "UDP datagrams lost in the kernel; cannot assert accounting"
+        assert srv.drain(20.0)
+    finally:
+        sock.close()
+    return len(lines)
+
+
+def _assert_identity(srv: Server, lines_sent: int):
+    """Zero silent loss: received == applied + counted_degraded, with
+    every term a counted registry total (1 line per datagram, so shed
+    packets count lines). Call only after a final flush (the engine
+    sample counters drain into the registry at flush)."""
+    tel = srv.telemetry
+    applied = tel.total(S, "samples.processed")
+    degraded = (tel.total(S, "overload.fold_sampled_out")
+                + tel.total(S, "overload.shed_packets")
+                + tel.total(S, "worker.dropped")
+                + tel.total(S, "packet.error"))
+    assert tel.total(S, "samples.dropped_no_slot") == 0
+    assert lines_sent == applied + degraded, (
+        f"silent loss: sent {lines_sent}, applied {applied}, "
+        f"degraded {degraded}")
+
+
+def _in_budget_lines() -> list[bytes]:
+    """The shared in-budget traffic both the storm servers and the
+    no-storm oracle ingest FIRST (so slot allocation order matches):
+    4 timer keys x 32 samples + 2 integer counters under `app.`."""
+    lines = []
+    for k in range(4):
+        for v in range(32):
+            lines.append(b"app.t%d:%s|ms"
+                         % (k, str(k * 3 + v * 0.25).encode()))
+    for k in range(2):
+        for _ in range(8):
+            lines.append(b"app.c%d:%d|c" % (k, k + 1))
+    return lines
+
+
+def _tenant_values(cap: CaptureMetricSink, prefix: str = "app.") -> dict:
+    """(name, tags) -> float for the in-budget tenant's flushed
+    metrics — the bit-identity comparison payload."""
+    return {(m.name, tuple(m.tags)): m.value
+            for m in cap.all_metrics if m.name.startswith(prefix)}
+
+
+def _oracle() -> dict:
+    """The no-storm oracle: same config, same in-budget traffic, no
+    storm. Returns the tenant metric values."""
+    srv, cap = _server()
+    try:
+        _send(srv, _in_budget_lines())
+        srv.flush_once(timestamp=100)
+        assert cap.wait_for_flush()
+        return _tenant_values(cap)
+    finally:
+        srv.stop()
+
+
+def test_storm_cardinality_explosion():
+    """Storm 1: 300 unique-tag counter keys against a budget of 8.
+    Bank minting capped, the rest folds into `bad.__other__` (itself a
+    mergeable counter carrying the exact folded total), accounting
+    identity exact, in-budget percentiles bit-identical to the
+    oracle."""
+    oracle = _oracle()
+    srv, cap = _server("overload_max_keys_per_prefix: 8\n")
+    try:
+        n = _send(srv, _in_budget_lines())
+        storm = [b"bad.u%d:1|c|#req:%d" % (k, k) for k in range(300)]
+        n += _send(srv, storm, already=n)
+        srv.flush_once(timestamp=100)
+        assert cap.wait_for_flush()
+
+        # --- bounded memory: the 37x-over-budget storm minted at most
+        # budget + 1 fold slot in the counter bank
+        eng = srv.engines[0]
+        bad_keys = [k for k in eng.counter_keys._map
+                    if k.name.startswith("bad.")]
+        assert len(bad_keys) == 8 + 1        # budget + __other__
+        assert len(eng.counter_keys) <= 2 + 8 + 1
+        # admission state is per-prefix, not per-key: a storm of any
+        # cardinality costs one _PrefixState (sketch_buckets bytes)
+        assert srv.admission.prefix_count() <= 2
+        # the registry carries counters, not per-key entries
+        dbg = srv.telemetry.debug_state()
+        assert len(dbg["counters"]) < 40
+
+        # --- zero silent loss (folded samples ARE applied — to the
+        # fold key — so they sit on the `applied` side)
+        _assert_identity(srv, n)
+        folded = srv.telemetry.total(S, "overload.folded_samples")
+        assert folded == 300 - 8
+        assert srv.telemetry.total(S, "overload.keys_over_budget") > 0
+
+        # --- the fold target aggregates the degraded keys exactly
+        other = [m for m in cap.all_metrics
+                 if m.name == "bad.__other__"]
+        assert len(other) == 1 and other[0].value == float(folded)
+        assert other[0].tags == []           # tagless: fleet-mergeable
+
+        # --- in-budget keys bit-identical to the no-storm oracle
+        assert _tenant_values(cap) == oracle
+
+        # --- /debug/flush-shaped state names the exploding prefix
+        st = srv.admission.debug_state()
+        rows = {r["prefix"]: r for r in st["prefixes"]}
+        assert rows["bad"]["over_budget"]
+        assert rows["bad"]["estimated_keys"] > 8 * 10  # 10x detected
+        assert not rows["app"]["over_budget"]
+    finally:
+        srv.stop()
+
+
+def test_storm_hot_key_skew():
+    """Storm 2: one timer key absorbing 24x the rest of the interval
+    combined. No degradation (skew is not cardinality), exact hot-key
+    totals through the hot-slot sidestep, in-budget percentiles
+    bit-identical to the oracle."""
+    oracle = _oracle()
+    srv, cap = _server("overload_max_keys_per_prefix: 8\n")
+    try:
+        n = _send(srv, _in_budget_lines())
+        hot = [b"hotkey.h:%d|ms" % (v % 97) for v in range(3000)]
+        n += _send(srv, hot, already=n)
+        srv.flush_once(timestamp=100)
+        assert cap.wait_for_flush()
+
+        eng = srv.engines[0]
+        assert len(eng.histo_keys) == 4 + 1  # app.t0..3 + the hot key
+        _assert_identity(srv, n)
+        for name in ("overload.folded_samples", "overload.shed_packets",
+                     "overload.fold_sampled_out"):
+            assert srv.telemetry.total(S, name) == 0
+
+        by_name = {m.name: m for m in cap.all_metrics}
+        assert by_name["hotkey.h.count"].value == 3000.0
+        assert by_name["hotkey.h.max"].value == 96.0
+        assert _tenant_values(cap) == oracle
+    finally:
+        srv.stop()
+
+
+def test_storm_sustained_over_capacity():
+    """Storm 3: every tick reads overloaded (tick_overrun_ratio makes
+    the wall tick always exceed it), so the governor halves the packet
+    admission rate down to its floor; subsequent ingest sheds whole
+    packets PRE-PARSE at that rate, counted, while survivors are
+    rate-corrected so the flushed counter total stays unbiased —
+    exactly `survivors / rate`. Healthy ticks recover the rate."""
+    srv, cap = _server(
+        "overload_tick_overrun_ratio: 0.000001\n"
+        "overload_min_sample_rate: 0.25\n")
+    try:
+        srv.admission._rng = random.Random(42)   # deterministic lottery
+        n = _send(srv, [b"cap.c:1|c"] * 200)
+        srv.flush_once(timestamp=100)            # overrun -> rate 0.5
+        assert cap.wait_for_flush(1)
+        assert srv.admission.shed_rate == 0.5
+        assert srv.admission.engaged
+
+        n += _send(srv, [b"cap.c:1|c"] * 400, already=n)
+        shed = srv.telemetry.total(S, "overload.shed_packets")
+        assert shed > 0
+        srv.flush_once(timestamp=200)            # flushes the survivors
+        assert cap.wait_for_flush(2)
+        assert srv.admission.shed_rate == 0.25   # halved again, floored
+
+        _assert_identity(srv, n)
+        # unbiased totals: each survivor carried sample_rate 0.5 ->
+        # weight 2, so the storm flush's counter is exactly 2x the
+        # survivor count (integer arithmetic, exact in the 2Sum bank)
+        survivors = 400 - shed
+        totals = [m.value for m in cap.all_metrics if m.name == "cap.c"]
+        assert totals == [200.0, 2.0 * survivors]
+
+        # the engaged governor reports through self-telemetry: flush 2
+        # drained the gauge staged during the overloaded tick
+        gauges = [m for m in cap.flushes[1]
+                  if m.name == "veneur.overload.adaptive_sample_rate"]
+        assert gauges and gauges[0].value == 0.5
+        shed_counters = [m for m in cap.flushes[1]
+                         if m.name == "veneur.overload.shed_packets_total"]
+        assert shed_counters and shed_counters[0].value == shed
+
+        # the storm tick's shed phase is in the flight-recorder ring
+        names = [p[0] for p in srv.flight.last_tick().phases()]
+        assert "overload" in names and "overload.shed" in names
+
+        # --- recovery: healthy ticks walk the rate back to 1.0
+        for _ in range(10):
+            srv.admission.on_tick(0.0, 3600.0, 0.0)
+            if srv.admission.shed_rate == 1.0:
+                break
+        assert srv.admission.shed_rate == 1.0
+        assert not srv.admission.engaged
+    finally:
+        srv.stop()
+
+
+def test_defense_off_is_a_regression_pinned_noop():
+    """`overload_defense_enabled: false` (the default) must behave
+    exactly like the pre-defense tree: no controller, free minting
+    under the same cardinality storm, no overload accounting."""
+    srv, cap = _server(defense=False)
+    try:
+        assert srv.admission is None
+        n = _send(srv, [b"bad.u%d:1|c" % k for k in range(300)])
+        srv.flush_once(timestamp=100)
+        assert cap.wait_for_flush()
+        eng = srv.engines[0]
+        assert len(eng.counter_keys) == 300     # minted freely
+        assert not any(m.name.endswith("__other__")
+                       for m in cap.all_metrics)
+        assert not any(m.name.startswith("veneur.overload.")
+                       for m in cap.all_metrics)
+        assert srv.telemetry.total(S, "samples.processed") == n
+        assert srv._debug_flush_state()["admission"] == \
+            {"enabled": False}
+    finally:
+        srv.stop()
+
+
+def test_debug_flush_exposes_admission_state():
+    """GET /debug/flush serves the admission surface next to the
+    ladder/breaker/journal state: budgets, per-prefix cardinality
+    estimates, the live sample rate, and the fold/shed counters."""
+    srv, cap = _server("overload_max_keys_per_prefix: 4\n"
+                       "http_address: \"127.0.0.1:0\"\n")
+    try:
+        _send(srv, [b"dbg.u%d:1|c" % k for k in range(40)])
+        srv.flush_once(timestamp=100)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http_api.port}/debug/flush",
+                timeout=10) as resp:
+            state = json.loads(resp.read())
+        adm = state["admission"]
+        assert adm["enabled"] is True
+        assert adm["max_keys_per_prefix"] == 4
+        assert adm["adaptive_sample_rate"] == 1.0
+        rows = {r["prefix"]: r for r in adm["prefixes"]}
+        assert rows["dbg"]["admitted"] == 4
+        assert rows["dbg"]["over_budget"] is True
+        assert rows["dbg"]["estimated_keys"] > 4
+        assert adm["counters"]["folded_samples"] == 36
+        assert adm["counters"]["shed_packets"] == 0
+        # the pre-existing surfaces still ride along
+        assert "flight_recorder" in state and "forward" in state
+    finally:
+        srv.stop()
+
+
+def test_multi_worker_folds_are_single_homed():
+    """num_workers > 1: over-budget samples fold in whichever engine
+    their ORIGINAL digest routed to, but the fold rewrite re-routes to
+    the fold key's home engine — so one flush emits exactly ONE
+    `<prefix>.__other__` row (duplicate same-name rows are
+    last-write-wins on several backends: folded volume would silently
+    vanish), conserving the storm's total exactly."""
+    oracle = _oracle()
+    srv, cap = _server("overload_max_keys_per_prefix: 8\n"
+                       "num_workers: 4\n")
+    try:
+        assert len(srv.engines) == 4
+        n = _send(srv, _in_budget_lines())
+        storm = [b"bad.u%d:1|c" % k for k in range(300)]
+        n += _send(srv, storm, already=n)
+        srv.flush_once(timestamp=100)
+        assert cap.wait_for_flush()
+
+        _assert_identity(srv, n)
+        folded = srv.telemetry.total(S, "overload.folded_samples")
+        assert folded == 300 - 8
+        # ONE row, carrying the exact folded total
+        other = [m for m in cap.all_metrics if m.name == "bad.__other__"]
+        assert len(other) == 1
+        assert other[0].value == float(folded)
+        # exact conservation across kept + folded
+        bad_total = sum(m.value for m in cap.all_metrics
+                        if m.name.startswith("bad."))
+        assert bad_total == 300.0
+        # the fold key minted in exactly one engine's interner
+        holders = [eng for eng in srv.engines
+                   if any(k.name == "bad.__other__"
+                          for k in eng.counter_keys._map)]
+        assert len(holders) == 1
+        assert _tenant_values(cap) == oracle
+    finally:
+        srv.stop()
+
+
+def test_multi_worker_import_folds_are_single_homed():
+    """The global tier with num_workers > 1: an over-budget FORWARDED
+    key whose fold target homes on another engine raises out of
+    import_* and the worker loop re-routes the rewritten aggregate —
+    one flush, one `<prefix>.__other__` row, exact folded total."""
+    from veneur_tpu.cluster.forward import HttpJsonForwarder
+
+    glob, gcap = _server("overload_max_keys_per_prefix: 2\n"
+                         "num_workers: 2\n"
+                         "http_address: \"127.0.0.1:0\"\n"
+                         "is_global: true\n")
+    try:
+        assert len(glob.engines) == 2
+        fwd = HttpJsonForwarder(
+            f"http://127.0.0.1:{glob.http_api.port}")
+        loc = Server(
+            read_config(text=_BASE_CFG
+                        + "forward_address: \"placeholder:1\"\n"),
+            sinks=[CaptureMetricSink()], plugins=[], span_sinks=[])
+        loc.forwarder = fwd
+        # feed engines synchronously (worker threads not started)
+        for k in range(12):
+            m = parse_metric(
+                b"imp.c%d:%d|c|#veneurglobalonly" % (k, k + 1))
+            loc.engines[m.digest % len(loc.engines)].process(m)
+        loc.flush_once(timestamp=50)     # real POST /import
+        assert glob.drain(20.0)
+        glob.flush_once(timestamp=100)
+        assert gcap.wait_for_flush()
+
+        other = [m for m in gcap.all_metrics
+                 if m.name == "imp.__other__"]
+        assert len(other) == 1
+        kept = [m for m in gcap.all_metrics
+                if m.name.startswith("imp.c")]
+        assert len(kept) == 2
+        # exact conservation: sum 1..12 split between kept and folded
+        assert sum(m.value for m in kept) + other[0].value == 78.0
+        assert glob.telemetry.total(S, "overload.folded_samples") == 10
+        holders = [eng for eng in glob.engines
+                   if any(k.name == "imp.__other__"
+                          for k in eng.counter_keys._map)]
+        assert len(holders) == 1
+    finally:
+        glob.stop()
+
+
+def test_local_only_folds_never_forward():
+    """veneurlocalonly's contract survives the fold: on a forwarding
+    server an over-budget LOCAL_ONLY sample folds into the prefix's
+    `.local` twin key (LOCAL_ONLY, flushed fully locally), NOT into
+    the GLOBAL_ONLY `__other__` that rides to the global tier — a
+    local-only value must never leave the host, and it must not share
+    a fold slot with forwarded folds (a slot's scope is per-key, so
+    one LOCAL_ONLY sample would retroactively rescope every sample
+    already folded there)."""
+    from veneur_tpu.cluster.forward import HttpJsonForwarder
+
+    glob, gcap = _server("http_address: \"127.0.0.1:0\"\n"
+                         "is_global: true\n", defense=False)
+    try:
+        fwd = HttpJsonForwarder(
+            f"http://127.0.0.1:{glob.http_api.port}")
+        lcap = CaptureMetricSink()
+        loc = Server(
+            read_config(text=_BASE_CFG
+                        + "overload_defense_enabled: true\n"
+                        + "overload_max_keys_per_prefix: 1\n"
+                        + "forward_address: \"placeholder:1\"\n"),
+            sinks=[lcap], plugins=[], span_sinks=[])
+        loc.forwarder = fwd
+        # feed engines synchronously (worker threads not started)
+        for line in (b"p.a:1|c",                        # mints (budget 1)
+                     b"p.secret:5|c|#veneurlocalonly",  # folds -> .local
+                     b"p.m:3|c"):                       # folds -> global
+            m = parse_metric(line)
+            loc.engines[m.digest % len(loc.engines)].process(m)
+        loc.flush_once(timestamp=50)     # real POST /import
+        assert glob.drain(20.0)
+        glob.flush_once(timestamp=100)
+        assert gcap.wait_for_flush()
+        assert lcap.wait_for_flush()
+
+        # the local-only value flushed fully locally, tagless
+        lo = [m for m in lcap.all_metrics
+              if m.name == "p.__other__.local"]
+        assert len(lo) == 1 and lo[0].value == 5.0 and lo[0].tags == []
+        # ... and never reached the global tier under ANY name
+        gvals = {m.name: m.value for m in gcap.all_metrics}
+        assert not any("local" in n for n in gvals)
+        # the MIXED fold rescoped GLOBAL_ONLY and merged at the global
+        assert gvals["p.__other__"] == 3.0
+        # it did NOT also flush locally (no duplicate series fleet-wide)
+        assert not any(m.name == "p.__other__" for m in lcap.all_metrics)
+        assert loc.telemetry.total(S, "overload.folded_samples") == 2
+    finally:
+        glob.stop()
+
+
+def test_import_path_folds_over_budget_keys():
+    """The global tier's Combine path: an over-budget FORWARDED key's
+    aggregate lands in `<prefix>.__other__` through the same merge
+    machinery — no sampling (a forwarded digest is an interval
+    aggregate, not a sample)."""
+    from veneur_tpu.ingest.admission import AdmissionController
+
+    eng = AggregationEngine(EngineConfig(
+        histogram_slots=256, counter_slots=128, gauge_slots=64,
+        set_slots=32, batch_size=512, percentiles=(0.5,),
+        aggregates=("count",)))
+    reg = observe.TelemetryRegistry()
+    adm = AdmissionController(registry=reg, max_keys_per_prefix=2)
+    eng.attach_admission(adm)
+    for k in range(10):
+        eng.import_counter(MetricKey(f"imp.c{k}", "counter", ""),
+                           float(k + 1))
+    # a histogram fold rides the centroid-merge path
+    for k in range(4):
+        eng.import_histogram(MetricKey(f"imp.h{k}", "timer", ""),
+                             [1.0 * k, 2.0 * k], [1.0, 1.0],
+                             0.0, 2.0 * k, 3.0 * k, 2.0, 0.0)
+    res = eng.flush(timestamp=1)
+    by_name = {m.name: m.value for m in res.metrics}
+    # counters: c0/c1 in budget; c2..c9 -> 3+4+...+10 = 52 folded
+    assert by_name["imp.c0"] == 1.0 and by_name["imp.c1"] == 2.0
+    assert by_name["imp.__other__"] == 52.0
+    assert not any(n.startswith("imp.c2") for n in by_name)
+    # histograms: budget already consumed by c0/c1? No — budgets count
+    # LIVE INTERNED KEYS per prefix across all banks, so h0..h3 are
+    # over budget and fold into the timer-typed `imp.__other__`
+    assert by_name["imp.__other__.count"] == 8.0
+    assert reg.total(S, "overload.folded_samples") == 8 + 4

@@ -130,3 +130,54 @@ def test_dispatch():
     assert isinstance(parser.parse_packet(b"a:1|c"), parser.UDPMetric)
     assert isinstance(parser.parse_packet(b"_e{1,1}:a|b"), parser.Event)
     assert isinstance(parser.parse_packet(b"_sc|n|0"), parser.ServiceCheck)
+
+
+def test_oversized_name_and_tag_rejected_not_interned():
+    """Parser hardening (ISSUE 7 satellite): an adversarial packet
+    minting a multi-KB metric name or tag is a COUNTED parse error —
+    it must fail BEFORE a MetricKey exists, never become an unbounded
+    interner entry. Boundary lengths still parse."""
+    # defaults: name bound
+    long_name = b"a" * (parser.MAX_NAME_LENGTH + 1)
+    with pytest.raises(ParseError):
+        parser.parse_metric(long_name + b":1|c")
+    ok = parser.parse_metric(b"a" * parser.MAX_NAME_LENGTH + b":1|c")
+    assert len(ok.key.name) == parser.MAX_NAME_LENGTH
+    # defaults: per-tag bound
+    long_tag = b"t:" + b"v" * parser.MAX_TAG_LENGTH
+    with pytest.raises(ParseError):
+        parser.parse_metric(b"m:1|c|#" + long_tag)
+    ok = parser.parse_metric(
+        b"m:1|c|#t:" + b"v" * (parser.MAX_TAG_LENGTH - 2))
+    assert len(ok.tags) == 1
+    # configured bounds thread through parse_packet
+    with pytest.raises(ParseError):
+        parser.parse_packet(b"abcdefghijklmnopq:1|c", None, 16, 16)
+    m = parser.parse_packet(b"abcdefghijklmnop:1|c", None, 16, 16)
+    assert m.key.name == "abcdefghijklmnop"
+    with pytest.raises(ParseError):
+        parser.parse_packet(b"m:1|c|#" + b"x" * 17, None, 16, 16)
+
+
+def test_server_counts_adversarial_packet_as_parse_error():
+    """End to end: the server's configured bounds reach the UDP parse
+    path; the adversarial packet increments packet.error and mints
+    nothing."""
+    from veneur_tpu.config import Config
+    from veneur_tpu.server import Server
+    from veneur_tpu.sinks.basic import CaptureMetricSink
+
+    cfg = Config(interval="3600s", hostname="h",
+                 metric_max_name_length=32,
+                 tpu_histogram_slots=256, tpu_counter_slots=128,
+                 tpu_gauge_slots=128, tpu_set_slots=64)
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                 span_sinks=[])
+    srv.start()
+    try:
+        srv.handle_packet(b"x" * 33 + b":1|c\nok.short:1|c")
+        assert srv.parse_errors == 1
+        assert srv.drain(10)
+        assert len(srv.engines[0].counter_keys) == 1  # only ok.short
+    finally:
+        srv.stop()

@@ -273,18 +273,100 @@ tpu_set_slots: 64
         srv.stop()
 
 
+def test_overload_counters_present_at_zero_and_drain():
+    """veneur.overload.* rides the unified telemetry spine (ISSUE 7):
+    with the defense armed, every interval reports the four
+    degradation counters — ZEROS INCLUDED (a zero is the steady-state
+    signal) — plus the live adaptive_sample_rate gauge; a storm
+    interval carries the real counts. The same names drain from ANY
+    TelemetryRegistry instance (per-server spine or the process
+    default), because the name mapping lives only in the registry."""
+    from veneur_tpu import resilience
+    from veneur_tpu.config import read_config
+    from veneur_tpu.ingest.admission import AdmissionController
+    from veneur_tpu.observe import SERVER_SCOPE
+
+    cap = CaptureMetricSink()
+    cfg = read_config(text="""
+interval: "3600s"
+hostname: h
+statsd_listen_addresses: ["udp://127.0.0.1:0"]
+overload_defense_enabled: true
+overload_max_keys_per_prefix: 2
+flush_phase_timers: false
+tpu_histogram_slots: 256
+tpu_counter_slots: 128
+tpu_gauge_slots: 128
+tpu_set_slots: 64
+""")
+    srv = Server(cfg, sinks=[cap], plugins=[], span_sinks=[])
+    srv.start()
+    try:
+        srv.flush_once(timestamp=1)      # idle interval: all zeros
+        cap.wait_for_flush(1)
+        zero = {m.name: m for m in cap.flushes[0]}
+        for name in ("veneur.overload.folded_samples_total",
+                     "veneur.overload.fold_sampled_out_total",
+                     "veneur.overload.keys_over_budget_total",
+                     "veneur.overload.shed_packets_total"):
+            assert name in zero and zero[name].value == 0.0, name
+        gauge = zero["veneur.overload.adaptive_sample_rate"]
+        assert gauge.value == 1.0 and gauge.tags == []
+
+        port = srv.bound_port()
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for k in range(10):              # 2 in budget, 8 folded
+            s.sendto(b"ov.u%d:1|c" % k, ("127.0.0.1", port))
+        deadline = time.monotonic() + 5
+        while srv.packets_received < 10 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.drain(5)
+        srv.flush_once(timestamp=2)
+        cap.wait_for_flush(2)
+        storm = {m.name: m for m in cap.flushes[1]}
+        assert storm["veneur.overload.folded_samples_total"].value == 8.0
+        assert storm["veneur.overload.shed_packets_total"].value == 0.0
+
+        # both registries: an admission controller counting into the
+        # process-default registry drains under the SAME wire names
+        resilience.DEFAULT_REGISTRY.take()
+        adm = AdmissionController(registry=resilience.DEFAULT_REGISTRY,
+                                  max_keys_per_prefix=1)
+        assert adm.admit_key(parser.MetricKey("p.a", "counter", ""))
+        assert adm.admit_key(parser.MetricKey("p.b", "counter", "")) \
+            is None
+        assert adm.fold_metric(parser.parse_metric(b"p.b:1|c"), 0) \
+            is not None
+        adm.count_folded()          # the engine counts once folds land
+        names = {m.name
+                 for m in resilience.DEFAULT_REGISTRY.drain(1, "h")}
+        assert "veneur.overload.folded_samples_total" in names
+        assert (SERVER_SCOPE, "overload.folded_samples") not in \
+            resilience.DEFAULT_REGISTRY.take()   # drained clean
+    finally:
+        srv.stop()
+
+
 def test_multi_engine_flush_overlaps():
     """Engines flush concurrently: on the tunneled TPU backend each
     engine's device_get pays a ~65-90ms wire floor, so N sequential
-    flushes cost N floors. flush_once must overlap them — with four
-    0.3s fake engines the tick takes ~1 floor, not ~4."""
+    flushes cost N floors. Every fake engine parks at a barrier until
+    all four are inside flush() at once — a serialized flush_once can
+    only get one there, so the barrier breaks after the timeout
+    instead of the wall-clock race a loaded box can lose."""
     from veneur_tpu.models.pipeline import FlushResult
 
     from veneur_tpu.metrics import MetricFrame
 
+    all_in_flush = threading.Barrier(4, timeout=10.0)
+    serialized = []
+
     class FakeEngine:
         def flush(self, timestamp=None):
-            time.sleep(0.3)
+            try:
+                all_in_flush.wait()
+            except threading.BrokenBarrierError:
+                serialized.append(True)
             return FlushResult(frame=MetricFrame(timestamp=1),
                                stats={"samples": 1})
 
@@ -296,10 +378,9 @@ def test_multi_engine_flush_overlaps():
                  tpu_gauge_slots=128, tpu_set_slots=64)
     srv = Server(cfg, sinks=[], plugins=[], span_sinks=[])
     srv.engines = [FakeEngine() for _ in range(4)]
-    t0 = time.monotonic()
     srv.flush_once(timestamp=1)
-    dt = time.monotonic() - t0
-    assert dt < 0.9, f"4x0.3s engine flushes took {dt:.2f}s (not overlapped)"
+    assert not serialized, \
+        "4 engine flushes never ran concurrently (flush_once serialized)"
 
 
 def test_slow_sink_does_not_delay_flush_tick():

@@ -123,6 +123,29 @@ def test_tl01_out_of_scope_modules_unchecked():
     assert [v for v in run_paths([path]) if v.rule == "TL01"] == []
 
 
+def test_ov01_uncounted_drop_verdicts():
+    # the uncounted branch drop (12), the count-in-another-branch drop
+    # (21) and the bare-return drop (39); the counted verdicts, the
+    # nested conditional count, the non-decision helper, and the
+    # suppressed escape all stay silent
+    assert lint("ov01_bad.py") == [("OV01", 12), ("OV01", 21),
+                                   ("OV01", 39)]
+
+
+def test_ov01_admission_layer_is_clean():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "ingest", "admission.py")
+    assert [v for v in run_paths([path]) if v.rule == "OV01"] == []
+
+
+def test_ov01_out_of_scope_modules_unchecked():
+    # decision-ish names outside the admission scope are not OV01's
+    # business (the resilience layer has its own accounting)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "resilience.py")
+    assert [v for v in run_paths([path]) if v.rule == "OV01"] == []
+
+
 def test_clean_fixture_is_clean():
     assert lint("clean.py") == []
 

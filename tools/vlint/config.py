@@ -51,6 +51,17 @@ DEFAULT_CONFIG = {
     "dr01_allow": (
         "veneur_tpu/durability/journal.py",
     ),
+    # OV01: counted-degradation discipline for the overload-defense
+    # layer (path substring match; /ov01_ scopes the check's own
+    # fixture in): a drop verdict (`return None`) in an admit*/fold*/
+    # shed* decision function must increment a registry counter in the
+    # same branch — silent degradation is the bug class this layer
+    # exists to eliminate.
+    "ov01_scope": (
+        "veneur_tpu/ingest/",
+        "/ov01_",
+    ),
+    "ov01_decision_prefixes": ("admit", "fold", "shed"),
     # TL01: where the veneur.* self-metric naming monopoly applies
     # (path substring match; /tl01_ scopes the check's own fixture in)
     # and the one module allowed to mint those names — the unified

@@ -1,5 +1,5 @@
 """Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02, DR01,
-TL01.
+TL01, OV01.
 
 All checks are intentionally conservative: they resolve only what can
 be resolved statically within the project (local jit wrappers, module
@@ -881,6 +881,73 @@ def check_tl01(mod: PyModule, config: dict) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------------------- OV01
+
+_OV01_COUNT_METHODS = ("incr", "mark")
+
+
+def _ov01_counts(node: ast.AST) -> bool:
+    """Does this subtree contain a registry counter update (an
+    `.incr(...)`/`.mark(...)` method call)?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _OV01_COUNT_METHODS:
+            return True
+    return False
+
+
+def check_ov01(mod: PyModule, config: dict) -> list[Violation]:
+    """Counted-degradation discipline (the overload-defense layer's
+    core contract): inside the admission scope, any function whose name
+    starts with admit/fold/shed is a degradation DECISION function, and
+    a drop verdict — `return None` (or a bare `return`) — must be
+    accompanied by a registry counter update in the same branch. The
+    "branch" is the innermost enclosing if/loop/try statement (its
+    whole subtree, so a conditional count like `if changed: incr(...)`
+    preceding the return qualifies), or the function body for a
+    top-level return. An uncounted drop is a silent-degradation bug:
+    the accounting identity `received == applied + counted_degraded`
+    the soak harness asserts can only hold if every verdict counts."""
+    if not any(m in mod.path for m in config["ov01_scope"]):
+        return []
+    prefixes = tuple(config["ov01_decision_prefixes"])
+    parents = _parent_map(mod.tree)
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not fn.name.lstrip("_").startswith(prefixes):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return):
+                continue
+            v = node.value
+            is_drop = v is None or (isinstance(v, ast.Constant)
+                                    and v.value is None)
+            if not is_drop:
+                continue
+            # the innermost enclosing branch statement WITHIN this
+            # function; the function body when the return is top-level
+            branch: ast.AST = fn
+            cur = parents.get(node)
+            while cur is not None and cur is not fn:
+                if isinstance(cur, (ast.If, ast.For, ast.While,
+                                    ast.Try)):
+                    branch = cur
+                    break
+                cur = parents.get(cur)
+            if not _ov01_counts(branch):
+                out.append(Violation(
+                    mod.path, node.lineno, "OV01",
+                    f"drop verdict in decision function `{fn.name}` "
+                    "without a registry counter in the same branch — "
+                    "degradation must be COUNTED (incr/mark) where it "
+                    "is decided, or the accounting identity "
+                    "`received == applied + counted_degraded` breaks "
+                    "silently"))
+    return out
+
+
 # ------------------------------------------------------------------- driver
 
 def check_module(mod: PyModule, ctx: Context, config: dict
@@ -895,4 +962,5 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_sr02(mod, config))
     out.extend(check_dr01(mod, config))
     out.extend(check_tl01(mod, config))
+    out.extend(check_ov01(mod, config))
     return out

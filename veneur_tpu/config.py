@@ -18,6 +18,8 @@ from dataclasses import dataclass, field, fields
 
 import yaml
 
+from .ingest import parser
+
 log = logging.getLogger("veneur_tpu.config")
 
 
@@ -125,6 +127,50 @@ class Config:
     # snapshot+compact a journal once it outgrows this many bytes
     # (checked at flush boundaries; atomic write-temp/fsync/rename)
     durability_snapshot_journal_bytes: int = 1 << 22
+
+    # --- overload defense (veneur_tpu/ingest/admission.py) ---
+    # Off by default: with the defense disabled the ingest path does
+    # zero admission work and behavior is identical to the pre-defense
+    # tree (regression-pinned). When on, per-prefix metric-key budgets
+    # bound bank growth under a cardinality storm (over-budget keys
+    # fold into the prefix's `__other__` sketch), and an adaptive
+    # packet-shed governor engages when the flush tick overruns the
+    # interval or worker queues saturate. Every degradation decision
+    # is counted (`veneur.overload.*`); vlint OV01 machine-checks it.
+    overload_defense_enabled: bool = False
+    # live interned keys a prefix (the name up to the first separator)
+    # may mint before new keys fold into `<prefix>.__other__`
+    overload_max_keys_per_prefix: int = 65536
+    # tracked prefixes; beyond this, new prefixes share one global
+    # `__other__` key (bounds the controller's own memory)
+    overload_max_prefixes: int = 4096
+    overload_prefix_separator: str = "."
+    overload_other_suffix: str = "__other__"
+    # sampling applied to samples folding into a hot `__other__` key
+    # (1.0 = fold everything); survivors are rate-corrected, so folded
+    # counter totals / histogram weights stay unbiased
+    overload_fold_sample_rate: float = 1.0
+    # the governor's floor: adaptive packet admission never drops below
+    # this rate, no matter how overloaded the tick signal reads
+    overload_min_sample_rate: float = 0.05
+    # a tick whose wall time exceeds this fraction of the flush
+    # interval reads as overloaded (multiplicative shed-rate decrease)
+    overload_tick_overrun_ratio: float = 0.8
+    # worker-queue fill fraction that reads as overloaded
+    overload_queue_high_watermark: float = 0.75
+    # reset the per-prefix cardinality estimators every N flush ticks
+    # (0 = never); the estimate is a per-window distinct-key count
+    overload_estimator_window_intervals: int = 64
+    # Huffman-Bucket estimator registers per prefix (power of two
+    # >= 16; 256 gives ~6.5% relative error at 256 bytes/prefix)
+    overload_sketch_buckets: int = 256
+    # --- parser hardening (counted rejection, not unbounded keys) ---
+    # metric names / individual tags longer than these are parse
+    # errors (veneur.packet.error_total), never interned keys.
+    # Defaults come from the parser so config-less library callers
+    # (parse_metric/parse_packet directly) enforce the same bounds.
+    metric_max_name_length: int = parser.MAX_NAME_LENGTH
+    metric_max_tag_length: int = parser.MAX_TAG_LENGTH
 
     # --- observability (veneur_tpu/observe/) ---
     # Flight recorder: every flush tick records its phase tree (drain /
@@ -323,6 +369,36 @@ def _validate(cfg: Config) -> None:
             "flight_recorder_ticks must be >= 1 and "
             "flight_recorder_max_phases >= 8 (a tick's fixed phases "
             "alone need that many slots)")
+    for key in ("overload_max_keys_per_prefix", "overload_max_prefixes"):
+        if getattr(cfg, key) < 1:
+            raise ValueError(f"{key} must be >= 1")
+    for key in ("overload_fold_sample_rate", "overload_min_sample_rate"):
+        v = getattr(cfg, key)
+        if not (0.0 < v <= 1.0):
+            raise ValueError(f"{key} must be in (0, 1], got {v!r}")
+    if cfg.overload_tick_overrun_ratio <= 0:
+        raise ValueError("overload_tick_overrun_ratio must be positive")
+    if not (0.0 < cfg.overload_queue_high_watermark <= 1.0):
+        raise ValueError(
+            "overload_queue_high_watermark must be in (0, 1]")
+    if cfg.overload_estimator_window_intervals < 0:
+        raise ValueError(
+            "overload_estimator_window_intervals must be >= 0 "
+            "(0 = never reset)")
+    b = cfg.overload_sketch_buckets
+    if b < 16 or (b & (b - 1)):
+        raise ValueError(
+            "overload_sketch_buckets must be a power of two >= 16, "
+            f"got {b}")
+    if cfg.overload_defense_enabled and not cfg.overload_prefix_separator:
+        raise ValueError(
+            "overload_defense_enabled requires a non-empty "
+            "overload_prefix_separator")
+    for key in ("metric_max_name_length", "metric_max_tag_length"):
+        if getattr(cfg, key) < 16:
+            raise ValueError(
+                f"{key} must be >= 16 (shorter limits would reject "
+                "ordinary metric traffic)")
     if cfg.debug_flush_profile and not cfg.debug_flush_profile_dir:
         raise ValueError(
             "debug_flush_profile requires a debug_flush_profile_dir")

@@ -50,6 +50,16 @@ class ParseError(ValueError):
     pass
 
 
+# Hardening defaults (config keys metric_max_name_length /
+# metric_max_tag_length): a metric name or single tag past these is a
+# COUNTED parse error, never an interned key — an adversarial packet
+# minting multi-KB names must not grow the bank or the interner map
+# (the overload-defense stance: degradation is counted, not silent,
+# and never unbounded). Callers with a Config pass its values through.
+MAX_NAME_LENGTH = 1024
+MAX_TAG_LENGTH = 512
+
+
 @dataclass(frozen=True)
 class MetricKey:
     name: str
@@ -92,19 +102,27 @@ class ServiceCheck:
 
 
 def parse_metric(packet: bytes,
-                 exclude_tags: frozenset | None = None) -> UDPMetric:
+                 exclude_tags: frozenset | None = None,
+                 max_name_len: int = MAX_NAME_LENGTH,
+                 max_tag_len: int = MAX_TAG_LENGTH) -> UDPMetric:
     """Parse one DogStatsD metric line (no trailing newline).
 
     `exclude_tags` (config.go sym: Config.TagsExclude) drops tags whose
     NAME (the part before ":", or the whole tag) matches — before key
     construction, so metrics differing only in an excluded tag aggregate
-    together, exactly like the reference."""
+    together, exactly like the reference. `max_name_len`/`max_tag_len`
+    reject oversized names/tags as parse errors BEFORE any key exists
+    (parser hardening — see MAX_NAME_LENGTH above)."""
     if not packet:
         raise ParseError("empty packet")
 
     colon = packet.find(b":")
     if colon <= 0:
         raise ParseError(f"missing name/value separator: {packet!r}")
+    if colon > max_name_len:
+        raise ParseError(
+            f"metric name over {max_name_len} bytes "
+            f"(got {colon}): {packet[:64]!r}...")
     name = packet[:colon]
     rest = packet[colon + 1:]
 
@@ -159,6 +177,10 @@ def parse_metric(packet: bytes,
                 raise ParseError(f"duplicate tag section in {packet!r}")
             seen_tags = True
             for t in section[1:].split(b","):
+                if len(t) > max_tag_len:
+                    raise ParseError(
+                        f"tag over {max_tag_len} bytes "
+                        f"(got {len(t)}): {t[:64]!r}...")
                 ts = t.decode("utf-8", "replace")
                 if ts == "veneurlocalonly":
                     scope = LOCAL_ONLY
@@ -276,11 +298,13 @@ def parse_service_check(packet: bytes) -> ServiceCheck:
     return sc
 
 
-def parse_packet(packet: bytes, exclude_tags: frozenset | None = None):
+def parse_packet(packet: bytes, exclude_tags: frozenset | None = None,
+                 max_name_len: int = MAX_NAME_LENGTH,
+                 max_tag_len: int = MAX_TAG_LENGTH):
     """Dispatch one datagram line to the right parser, like
     Server.HandleMetricPacket (server.go)."""
     if packet.startswith(b"_e{"):
         return parse_event(packet)
     if packet.startswith(b"_sc|"):
         return parse_service_check(packet)
-    return parse_metric(packet, exclude_tags)
+    return parse_metric(packet, exclude_tags, max_name_len, max_tag_len)

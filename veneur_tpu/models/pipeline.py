@@ -42,7 +42,7 @@ from ..ingest.parser import (
 from ..metrics import InterMetric, MetricFrame, MetricType
 from ..ops import hll, scalar, tdigest
 from ..utils import hashing
-from .worker import KeyInterner
+from .worker import FOLD_SLOT, KeyInterner
 
 logger = logging.getLogger(__name__)
 
@@ -399,6 +399,22 @@ def decompact_flush_host(host: dict, agg_emit: tuple) -> dict:
     return host
 
 
+class ImportFoldReroute(Exception):
+    """An over-budget IMPORTED key's fold target is homed on another
+    engine (overload defense, multi-worker server): raised out of the
+    engine's import_* before any staging, carrying the fold key so the
+    worker loop can rewrite the aggregate's pb onto it and re-route.
+    Deliberately an Exception subclass raised BEFORE the worker loop's
+    poison-pill guard gets to see it (the loop catches this type
+    first); it must never escape to a caller that treats it as a
+    corrupted metric."""
+
+    def __init__(self, key: MetricKey, digest: int):
+        super().__init__(f"fold of imported key rehomes to {key.name}")
+        self.key = key
+        self.digest = digest
+
+
 @dataclass
 class EngineConfig:
     histogram_slots: int = 1 << 15
@@ -619,6 +635,13 @@ class AggregationEngine:
         self._pres_bound = 4 * (cfg.histogram_slots + cfg.counter_slots
                                 + cfg.gauge_slots + cfg.set_slots)
         self.samples_processed = 0
+        # Overload defense (ingest/admission.py): attached by the
+        # Server via attach_admission; None = every key mints freely
+        # (direct engine construction, the pre-defense behavior).
+        self._adm = None
+        self._adm_index = 0
+        self._adm_n = 1
+        self._adm_reroute = None
         # Imported (Combine) staging for the global tier — everything is
         # batched so a 32-shard import costs a handful of device calls,
         # not one per key.
@@ -635,6 +658,76 @@ class AggregationEngine:
 
     # ---------------- ingest ----------------
 
+    def attach_admission(self, adm, *, index: int = 0, n: int = 1,
+                         reroute=None):
+        """Wire the Server's admission controller into this engine's
+        slot minting (overload defense): each KeyInterner consults it
+        before allocating, and over-budget keys' samples re-stage onto
+        their prefix's `__other__` key via `_fold` instead of minting
+        a bank slot. A map-hit key never touches the controller, so
+        the steady-state ingest path is unchanged.
+
+        `index`/`n`/`reroute` single-home the fold keys in a
+        multi-worker server: a fold rewrite whose digest routes to a
+        DIFFERENT engine is handed back to the server's router
+        (`reroute`) instead of minting a local slot, so one flush
+        never emits the same `__other__` series from two engines —
+        duplicate same-name rows are last-write-wins on several
+        backends, which would silently lose folded volume."""
+        self._adm = adm
+        self._adm_index = index
+        self._adm_n = n
+        self._adm_reroute = reroute
+        for ki in (self.histo_keys, self.counter_keys,
+                   self.gauge_keys, self.set_keys):
+            ki.admission = adm
+
+    def _fold(self, interner, m: UDPMetric):
+        """Resolve an over-budget sample (lookup returned FOLD_SLOT)
+        into (fold-rewritten metric, slot), or (None, -1) when the
+        sample left this engine: sampled out (admission counts it —
+        and it must then not count as processed either, the accounting
+        identity `received == applied + counted_degraded` is exact),
+        re-routed to the fold key's home engine (counted as folded
+        here; the home engine processes it as an ordinary sample), or
+        refused by the full bank (the interner's dropped_no_slot
+        accounting, exactly like any over-full sample — NOT counted
+        as a fold)."""
+        fm = self._adm.fold_metric(m, self._fwd_out)
+        if fm is None:
+            self.samples_processed -= 1
+            return None, -1
+        if self._adm_n > 1 and fm.digest % self._adm_n != self._adm_index:
+            self.samples_processed -= 1      # the home engine counts it
+            self._adm.count_folded()
+            self._adm_reroute(fm)
+            return None, -1
+        slot = interner.lookup(fm.key, fm.scope)
+        if slot < 0:
+            return None, -1
+        self._adm.count_folded()
+        return fm, slot
+
+    def _fold_import_slot(self, interner, key: MetricKey) -> int:
+        """Import-path fold (the global tier's Combine): redirect an
+        over-budget forwarded key's slot to the fold key — the merge
+        machinery is unchanged, the aggregate just lands in
+        `<prefix>.__other__` (no sampling: a forwarded digest is an
+        interval aggregate, not a sample). In a multi-worker server a
+        fold key homed on another engine raises ImportFoldReroute so
+        the worker loop re-routes the aggregate there (single-homed,
+        like the ingest path)."""
+        if self._adm is None:
+            return -1
+        fk, digest = self._adm.fold_key(key)
+        if self._adm_n > 1 and digest % self._adm_n != self._adm_index:
+            self._adm.count_folded()
+            raise ImportFoldReroute(fk, digest)
+        slot = interner.lookup(fk, GLOBAL_ONLY)
+        if slot >= 0:
+            self._adm.count_folded()
+        return slot
+
     def process(self, m: UDPMetric):
         """Route one parsed sample to its bank's staging buffer — the
         Worker.ProcessMetric equivalent. Thread-safe against flush()."""
@@ -647,7 +740,11 @@ class AggregationEngine:
         if t in ("timer", "histogram"):
             slot = self.histo_keys.lookup(m.key, m.scope)
             if slot < 0:
-                return
+                if slot != FOLD_SLOT:
+                    return
+                m, slot = self._fold(self.histo_keys, m)
+                if m is None:
+                    return
             st = self._histo_stage
             st.put(slots=slot, values=m.value, weights=1.0 / m.sample_rate)
             if st.full():
@@ -655,7 +752,11 @@ class AggregationEngine:
         elif t == "counter":
             slot = self.counter_keys.lookup(m.key, m.scope)
             if slot < 0:
-                return
+                if slot != FOLD_SLOT:
+                    return
+                m, slot = self._fold(self.counter_keys, m)
+                if m is None:
+                    return
             st = self._counter_stage
             st.put(slots=slot, values=m.value, weights=1.0 / m.sample_rate)
             if st.full():
@@ -663,7 +764,11 @@ class AggregationEngine:
         elif t == "gauge":
             slot = self.gauge_keys.lookup(m.key, m.scope)
             if slot < 0:
-                return
+                if slot != FOLD_SLOT:
+                    return
+                m, slot = self._fold(self.gauge_keys, m)
+                if m is None:
+                    return
             st = self._gauge_stage
             self._gauge_seq += 1
             st.put(slots=slot, values=m.value, seqs=self._gauge_seq)
@@ -672,7 +777,11 @@ class AggregationEngine:
         elif t == "set":
             slot = self.set_keys.lookup(m.key, m.scope)
             if slot < 0:
-                return
+                if slot != FOLD_SLOT:
+                    return
+                m, slot = self._fold(self.set_keys, m)
+                if m is None:
+                    return
             # Inline int bit ops (no numpy round-trip) — this is the
             # per-sample hot path.
             p = self.cfg.hll_precision
@@ -926,6 +1035,8 @@ class AggregationEngine:
         (importsrv path, worker.go sym: Worker.ImportMetricGRPC)."""
         with self.lock:
             slot = self.histo_keys.lookup(key, GLOBAL_ONLY)
+            if slot == FOLD_SLOT:
+                slot = self._fold_import_slot(self.histo_keys, key)
             if slot < 0:
                 return
             means = np.asarray(means, np.float32)
@@ -942,6 +1053,8 @@ class AggregationEngine:
     def import_set(self, key: MetricKey, registers):
         with self.lock:
             slot = self.set_keys.lookup(key, GLOBAL_ONLY)
+            if slot == FOLD_SLOT:
+                slot = self._fold_import_slot(self.set_keys, key)
             if slot < 0:
                 return
             self._import_sets.append(
@@ -952,6 +1065,8 @@ class AggregationEngine:
     def import_counter(self, key: MetricKey, value: float):
         with self.lock:
             slot = self.counter_keys.lookup(key, GLOBAL_ONLY)
+            if slot == FOLD_SLOT:
+                slot = self._fold_import_slot(self.counter_keys, key)
             if slot < 0:
                 return
             # Host-side f64 accumulation — exact, one device call per flush.
@@ -961,6 +1076,8 @@ class AggregationEngine:
     def import_gauge(self, key: MetricKey, value: float):
         with self.lock:
             slot = self.gauge_keys.lookup(key, GLOBAL_ONLY)
+            if slot == FOLD_SLOT:
+                slot = self._fold_import_slot(self.gauge_keys, key)
             if slot < 0:
                 return
             self._import_gauge_acc[slot] = float(value)  # last write wins

@@ -13,6 +13,13 @@ from dataclasses import dataclass
 
 from ..ingest.parser import MetricKey
 
+# Sentinel returned by KeyInterner.lookup when the attached admission
+# controller refuses to mint a slot for a new key (over its prefix's
+# budget): the caller folds the sample into the prefix's `__other__`
+# key instead (models/pipeline.py `_fold`). Distinct from -1 (bank
+# full), which remains a counted drop.
+FOLD_SLOT = -2
+
 
 @dataclass
 class SlotInfo:
@@ -39,6 +46,11 @@ class KeyInterner:
         self._by_slot: list[MetricKey | None] = [None] * capacity
         self.interval = 0
         self.dropped_no_slot = 0
+        # Overload defense (ingest/admission.py), attached by
+        # AggregationEngine.attach_admission: consulted ONLY on the
+        # allocation path — a key already holding a slot pays zero
+        # admission cost (the map hit above is the whole hot path).
+        self.admission = None
 
     def __len__(self):
         return len(self._map)
@@ -46,13 +58,20 @@ class KeyInterner:
     def lookup(self, key: MetricKey, scope: int) -> int:
         """Return the slot for `key`, allocating if new. -1 if the bank is
         full (caller counts the drop — the analogue of worker channel
-        backpressure drops, which veneur also counts rather than blocks)."""
+        backpressure drops, which veneur also counts rather than blocks);
+        FOLD_SLOT (-2) if the admission controller refused the slot
+        (over-budget key: caller folds into the prefix's other-key)."""
         info = self._map.get(key)
         if info is not None:
             info.last_interval = self.interval
             info.scope = scope
             return info.slot
+        adm = self.admission
+        if adm is not None and adm.admit_key(key) is None:
+            return FOLD_SLOT
         if not self._free:
+            if adm is not None:
+                adm.release_key(key)   # admitted, but no slot to mint
             self.dropped_no_slot += 1
             return -1
         slot = self._free.pop()
@@ -88,7 +107,10 @@ class KeyInterner:
             return
         dead = [k for k, info in self._map.items()
                 if info.last_interval < horizon]
+        adm = self.admission
         for k in dead:
             info = self._map.pop(k)
             self._by_slot[info.slot] = None
             self._free.append(info.slot)
+            if adm is not None:
+                adm.release_key(k)   # budget follows bank occupancy

@@ -36,6 +36,7 @@ import numpy as np
 from ..ingest.parser import GLOBAL_ONLY
 from ..models.pipeline import (AggregationEngine, EngineConfig,
                                _precluster_k1, stage_copy_executable)
+from ..models.worker import FOLD_SLOT
 from .mesh import MeshEngine, make_mesh
 
 logger = logging.getLogger(__name__)
@@ -324,6 +325,11 @@ class MeshAggregationEngine(AggregationEngine):
                          vsum, count, recip=0.0):
         with self.lock:
             slot = self.histo_keys.lookup(key, GLOBAL_ONLY)
+            if slot == FOLD_SLOT:
+                # overload defense: over-budget forwarded keys fold
+                # into `<prefix>.__other__` here too (the mesh server
+                # is a single engine, so the fold is always local)
+                slot = self._fold_import_slot(self.histo_keys, key)
             if slot < 0:
                 return
             means = np.asarray(means, np.float64)
@@ -363,6 +369,8 @@ class MeshAggregationEngine(AggregationEngine):
     def import_set(self, key, registers):
         with self.lock:
             slot = self.set_keys.lookup(key, GLOBAL_ONLY)
+            if slot == FOLD_SLOT:
+                slot = self._fold_import_slot(self.set_keys, key)
             if slot < 0:
                 return
             self._import_sets.append(

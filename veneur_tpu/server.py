@@ -102,6 +102,31 @@ class Server:
         # read-only properties over the registry. Built before the
         # sinks: the Prometheus scrape surface captures it.
         self.telemetry = observe.TelemetryRegistry()
+        # Overload defense (ingest/admission.py): ONE controller shared
+        # by every engine's KeyInterners (per-prefix key budgets +
+        # fold-to-other) and by handle_packet (adaptive shed governor).
+        # None = defense off, the regression-pinned pre-defense path.
+        self.admission = None
+        self._rate_corrected_types = None
+        if cfg.overload_defense_enabled:
+            if self.native_bridge is not None:
+                log.warning(
+                    "overload_defense_enabled has no effect with "
+                    "native_ingest (the C++ bridge owns interning); "
+                    "defense disabled")
+            else:
+                from .ingest import admission as _admission
+                self.admission = _admission.from_config(cfg,
+                                                        self.telemetry)
+                self._rate_corrected_types = \
+                    _admission.RATE_CORRECTED_TYPES
+                # index/n/reroute single-home each fold key on the
+                # engine its digest routes to — one flush, one row
+                # per `__other__` series, however many workers
+                for i, eng in enumerate(self.engines):
+                    eng.attach_admission(
+                        self.admission, index=i, n=len(self.engines),
+                        reroute=self._route_metric)
         # one shared egress policy (retry/breaker knobs) for every
         # config-built sink and forwarder; per-destination breakers are
         # created inside each Egress
@@ -237,6 +262,17 @@ class Server:
         # differing only in an excluded tag aggregate together), in both
         # the Python parser and the C++ bridge's.
         self._exclude_tags = frozenset(cfg.tags_exclude) or None
+        # parser hardening bounds (counted rejection, never an
+        # unbounded interned key)
+        self._max_name_len = cfg.metric_max_name_length
+        self._max_tag_len = cfg.metric_max_tag_length
+        if self.native_bridge is not None and (
+                self._max_name_len != parser.MAX_NAME_LENGTH
+                or self._max_tag_len != parser.MAX_TAG_LENGTH):
+            log.warning(
+                "metric_max_name_length/metric_max_tag_length have no "
+                "effect with native_ingest (the C++ bridge parses and "
+                "interns without the bounds)")
         if self._exclude_tags and self.native_bridge is not None:
             self.native_bridge.set_tags_exclude(sorted(
                 self._exclude_tags))
@@ -392,7 +428,9 @@ class Server:
             the same tags_exclude as the fast path or one logical
             metric splits into two series."""
             try:
-                item = parser.parse_packet(line, self._exclude_tags)
+                item = parser.parse_packet(line, self._exclude_tags,
+                                           self._max_name_len,
+                                           self._max_tag_len)
             except parser.ParseError:
                 self._count("packet.error")
                 return
@@ -1073,14 +1111,39 @@ class Server:
             # self-metrics at flush
             self.native_bridge.handle_packet(data)
             return
+        # Overload backpressure (ingest/admission.py): when the
+        # governor is engaged, shed WHOLE datagrams pre-parse at the
+        # adaptive rate (the cheapest possible drop — no parse, no
+        # queue; counted as veneur.overload.shed_packets_total) and
+        # rate-correct the surviving counter/timer/histogram samples
+        # so flushed totals stay unbiased. Disengaged (the steady
+        # state, and always when the defense is off) this costs one
+        # attribute load + None check per datagram.
+        adm = self.admission
+        shed_rate = 1.0
+        if adm is not None and adm.shed_rate < 1.0:
+            if adm.admit_packet() is None:
+                # the datagram WAS received; its loss is the counted
+                # degradation (received == applied + counted_degraded)
+                self._count("packet.received")
+                return
+            shed_rate = adm.shed_rate
         for line in data.split(b"\n"):
             if not line:
                 continue
             try:
-                item = parser.parse_packet(line, self._exclude_tags)
+                item = parser.parse_packet(line, self._exclude_tags,
+                                           self._max_name_len,
+                                           self._max_tag_len)
             except parser.ParseError:
                 self._count("packet.error")
                 continue
+            if shed_rate < 1.0 and isinstance(item, parser.UDPMetric) \
+                    and item.key.type in self._rate_corrected_types:
+                # survivor of the shed lottery: weight it up so
+                # counter totals / histogram weights stay unbiased
+                item.sample_rate = max(item.sample_rate * shed_rate,
+                                       1e-9)
             self._route_metric(item)
         # counted after routing so a waiter that observes the count and
         # then drain()s cannot race ahead of the lines
@@ -1091,6 +1154,7 @@ class Server:
         Worker.ImportMetricGRPC for forwarded metrics)."""
         from .cluster.importsrv import ImportedMetric
         from .cluster.wire import apply_metric_to_engine
+        from .models import pipeline
 
         eng = self.engines[idx]
         while True:
@@ -1108,6 +1172,19 @@ class Server:
                     # whole queue shard forever
                     try:
                         apply_metric_to_engine(eng, item.pb)
+                    except pipeline.ImportFoldReroute as fr:
+                        # overload defense: the fold key is homed on
+                        # another engine — rewrite the aggregate onto
+                        # it and re-route (single-homed folds; the
+                        # home engine admits it as an ordinary import)
+                        item.pb.name = fr.key.name
+                        del item.pb.tags[:]
+                        try:
+                            self.worker_queues[
+                                fr.digest
+                                % len(self.worker_queues)].put_nowait(item)
+                        except queue.Full:
+                            self._count("worker.dropped")
                     except Exception as e:
                         self._count("import.rejected")
                         log.warning(
@@ -1391,6 +1468,33 @@ class Server:
                 observe.reset_current_tick(dtok)
             if dp != -1:
                 tick.finish(dp)
+
+        # Overload governor boundary: adapt the shed rate from this
+        # tick's wall duration (overrun = the flush can't keep up with
+        # ingest) and the worst worker-queue fill, then record the
+        # interval's degradation as phases — a storm tick shows its
+        # fold/shed volume in the flight-recorder ring, next to the
+        # phases explaining WHY the tick overran.
+        adm = self.admission
+        if adm is not None:
+            op = -1 if tick is None else tick.start("overload")
+            qfill = max((q.qsize() / q.maxsize
+                         for q in self.worker_queues), default=0.0)
+            delta = adm.on_tick(time.monotonic() - t0,
+                                self.cfg.interval_seconds, qfill)
+            if tick is not None:
+                if delta["folded"] or delta["sampled_out"] \
+                        or delta["over_budget"]:
+                    tick.finish(
+                        tick.start("overload.fold", op),
+                        folded=delta["folded"],
+                        sampled_out=delta["sampled_out"],
+                        keys_over_budget=delta["over_budget"])
+                if delta["shed"]:
+                    tick.finish(tick.start("overload.shed", op),
+                                shed=delta["shed"])
+                tick.finish(op, rate=delta["rate"],
+                            overloaded=delta["overloaded"])
         return frameset
 
     # ------------- on-demand jax.profiler capture -------------
@@ -1453,6 +1557,11 @@ class Server:
                                 else self.flight.debug_state()),
             "forward": (fwd.debug_state()
                         if hasattr(fwd, "debug_state") else None),
+            # overload defense: budgets, per-prefix cardinality
+            # estimates, governor rate, fold/shed counters
+            "admission": (self.admission.debug_state()
+                          if self.admission is not None
+                          else {"enabled": False}),
             "dedupe_ledger": None,
             "durability": {
                 "forward_journal_bytes": (
@@ -1522,6 +1631,17 @@ class Server:
             # are serialized (one flusher thread, tests call flush_once
             # synchronously), so no concurrent writer exists
             self._last_bridge_stats = st
+        if self.admission is not None:
+            # overload counters report every interval, zeros included
+            # (a zero IS the steady-state signal: the defense is armed
+            # and degrading nothing), plus the live governor rate
+            for name in ("overload.folded_samples",
+                         "overload.fold_sampled_out",
+                         "overload.keys_over_budget",
+                         "overload.shed_packets"):
+                tel.mark(S, name, 0)
+            tel.set_gauge(S, "overload.adaptive_sample_rate",
+                          self.admission.shed_rate)
         tel.set_gauge(S, "flush.total_duration_ns",
                       (time.monotonic() - t0) * 1e9)
         if self.dedupe_ledger is not None:
