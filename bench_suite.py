@@ -1439,6 +1439,156 @@ tpu_batch_size: 2048
           note="counterfactual unbounded minting the defense stops")
 
 
+def config15_fleet_tracing():
+    """Fleet-scope tracing overhead A/B (ISSUE 8).
+
+    Prices the tentpole's three per-tick costs at the c12 interval
+    shape: (a) the SENDER's trace stamp — two extra headers per wire
+    chunk, ids read off the tick record; (b) the RECEIVER's fleet
+    bookkeeping — one observe_interval per admitted chunk plus one
+    on_flush sweep per tick; (c) the e2e timer dogfood — one
+    UDPMetric per (sender, interval) routed like any tenant sample.
+    Each micro-cost is min-over-reps (this box's virtualized CPU
+    drifts ±30% at second timescales — same estimator as c13/c14),
+    the tick wall comes from a REAL Server.flush_once at the c12
+    shape, and the defensible number is the edge-model row: total
+    tracing work per tick / tick wall, gated < 1%. A wall A/B at this
+    magnitude would measure the scheduler, not the ~µs of stamping —
+    c13 demonstrated that for the recorder itself."""
+    from veneur_tpu.cluster import wire
+    from veneur_tpu.config import read_config
+    from veneur_tpu.observe import FleetView, e2e_timer_samples
+    from veneur_tpu.server import Server
+    from veneur_tpu.sinks.basic import CaptureMetricSink
+
+    n, reps = 10_000, 8
+
+    def _floor(body) -> float:
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            body()
+            best = min(best, time.perf_counter() - t0)
+        return best / n
+
+    # (a) sender trace stamp: envelope headers with vs without the
+    # trace context — the delta IS the wire-stamp cost per chunk
+    def _headers_plain():
+        for _ in range(n):
+            wire.envelope_headers("bench-sender", 42, 0, 3)
+
+    def _headers_traced():
+        for _ in range(n):
+            wire.envelope_headers("bench-sender", 42, 0, 3,
+                                  trace_id=987654321, span_id=12345678,
+                                  close_ns=1_700_000_000_000_000_000)
+
+    _headers_traced()                            # warm
+    per_plain = _floor(_headers_plain)
+    per_traced = _floor(_headers_traced)
+    stamp_ns = max(0.0, (per_traced - per_plain) * 1e9)
+    _emit("c15_trace_stamp_cost_ns_per_chunk", stamp_ns, "ns", None,
+          larger_is_better=False,
+          headers_plain_ns=round(per_plain * 1e9),
+          headers_traced_ns=round(per_traced * 1e9))
+
+    # (b) receiver fleet bookkeeping: observe_interval per chunk and
+    # the per-tick on_flush sweep (8 senders x 4 pending intervals)
+    fv = FleetView(max_senders=64, window=256, clock=lambda: 10**9)
+
+    def _observe():
+        for i in range(n):
+            fv.observe_interval("snd-%d" % (i & 7), i, close_ns=10**9)
+
+    _observe()
+    per_observe = _floor(_observe)
+    _emit("c15_fleet_observe_cost_ns_per_chunk", per_observe * 1e9,
+          "ns", None, larger_is_better=False)
+
+    def _onflush_sweep():
+        for i in range(256):
+            for s in range(8):
+                for k in range(4):
+                    fv.observe_interval("snd-%d" % s, i * 4 + k,
+                                        close_ns=10**9)
+            fv.on_flush(2 * 10**9)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _onflush_sweep()
+        best = min(best, time.perf_counter() - t0)
+    onflush_ns = best / 256 * 1e9     # per tick, 32 pending intervals
+    _emit("c15_fleet_onflush_cost_ns_per_tick", onflush_ns, "ns", None,
+          larger_is_better=False, senders=8, intervals_per_tick=32)
+
+    # (c) e2e timer dogfood: sample construction per (sender, interval)
+    per_sender = {"snd-%d" % s: [12.5] * 4 for s in range(8)}
+    m, best = 200, float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(m):
+            e2e_timer_samples(per_sender)
+        best = min(best, time.perf_counter() - t0)
+    e2e_ns = best / m * 1e9           # per tick, 32 samples
+    _emit("c15_e2e_samples_cost_ns_per_tick", e2e_ns, "ns", None,
+          larger_is_better=False, samples_per_tick=32)
+
+    # ---- tick wall at the c12 shape (real server, real flush) ----
+    cfg = read_config(text="""
+interval: "3600s"
+hostname: bench
+percentiles: [0.5, 0.99]
+aggregates: ["min", "max", "count"]
+tpu_histogram_slots: 1024
+tpu_counter_slots: 2048
+tpu_gauge_slots: 512
+tpu_set_slots: 256
+tpu_batch_size: 2048
+tpu_buffer_depth: 256
+""")
+    lines = []
+    for k in range(256):
+        lines.append(b"bench.h%d:%d.5|ms" % (k, k))
+    for k in range(64):
+        lines.append(b"bench.s%d:u%d|s" % (k, k))
+    for k in range(1024):
+        lines.append(b"bench.c%d:1|c" % k)
+    for k in range(256):
+        lines.append(b"bench.g%d:2|g" % k)
+    payload = b"\n".join(lines)
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                 span_sinks=[])
+    srv.start()
+    ticks = []
+    try:
+        for i in range(12):
+            srv.handle_packet(payload)
+            assert srv.drain(30.0)
+            t0 = time.perf_counter()
+            srv.flush_once(timestamp=100 + i)
+            if i >= 2:
+                ticks.append(time.perf_counter() - t0)
+    finally:
+        srv.stop()
+    tick_ms = float(np.median(ticks) * 1e3)
+    _emit("c15_flush_tick_ms_c12_shape", tick_ms, "ms", None,
+          larger_is_better=False)
+
+    # ---- the edge-model row: both tiers' whole tracing budget per
+    # tick vs the tick wall, at a generous 32 wire chunks/tick (the
+    # chaos harness ships 3; a 100k-histo forward ships ~10 at
+    # max_per_batch=10k) ----
+    chunks = 32
+    per_tick_ns = (chunks * (stamp_ns + per_observe * 1e9)
+                   + onflush_ns + e2e_ns)
+    model_pct = per_tick_ns / (tick_ms * 1e6) * 100.0
+    _emit("c15_fleet_tracing_overhead_model_pct", model_pct, "pct",
+          1.0, larger_is_better=False, chunks_per_tick=chunks,
+          note="sender stamp + receiver bookkeeping + e2e dogfood, "
+               "all at once, vs the measured c12 tick — the < 1% "
+               "acceptance gate")
+
+
 CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            3: config3_sets_1m_uniques, 4: config4_forward_merge_32_shards,
            5: config5_multichip_100k, 6: config6_e2e_udp_ingest,
@@ -1447,7 +1597,8 @@ CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            7: config7_mesh_global_merge, 8: config8_ingest_stages,
            12: config12_durability_journal,
            13: config13_flight_recorder,
-           14: config14_admission_defense}
+           14: config14_admission_defense,
+           15: config15_fleet_tracing}
 
 
 def _run_isolated(configs: list[int], json_out: str) -> int:

@@ -136,6 +136,94 @@ class TestEnvelopeEncodeDecodeParity:
                  wire.ENVELOPE_SEQ_HEADER: "nan",
                  wire.ENVELOPE_CHUNK_HEADER: "0/1"})
 
+    def test_trace_context_parity_all_three_arms(self):
+        """ISSUE 8: the fleet-trace context (trace_id, span_id,
+        close_ns) rides alongside the envelope on all three carriers —
+        MetricList.envelope fields 5-7, the serialized-Envelope V2
+        metadata, and the X-Veneur-Trace-* headers — with every codec
+        mirrored in wire.py. Zeros encode to NOTHING (legacy byte
+        parity) and malformed context decodes to None, never an error
+        (trace loss must not cost an interval)."""
+        # pb arm
+        e = wire.envelope_pb("s", 1, 0, 1, trace_id=11, span_id=22,
+                             close_ns=33)
+        ml = forward_pb2.MetricList(envelope=e)
+        assert wire.trace_from_metric_list(ml) == (11, 22, 33)
+        assert wire.envelope_from_metric_list(ml) == ("s", 1, 0, 1)
+        plain = forward_pb2.MetricList(
+            envelope=wire.envelope_pb("s", 1, 0, 1))
+        assert wire.trace_from_metric_list(plain) is None
+        # V2 metadata arm (shares the envelope's carrier)
+        md = [(wire.ENVELOPE_METADATA_KEY, e.SerializeToString())]
+        assert wire.trace_from_metadata(md) == (11, 22, 33)
+        assert wire.trace_from_metadata(None) is None
+        assert wire.trace_from_metadata(
+            [(wire.ENVELOPE_METADATA_KEY, b"\xff\xfe garbage")]) is None
+        # header arm
+        hs = wire.envelope_headers("s", 1, 0, 1, trace_id=11,
+                                   span_id=22, close_ns=33)
+        assert wire.trace_from_headers(hs) == (11, 22, 33)
+        assert wire.envelope_from_headers(hs) == ("s", 1, 0, 1)
+        # zero trace -> byte-identical legacy header set
+        assert wire.envelope_headers("s", 1, 0, 1) == \
+            wire.envelope_headers("s", 1, 0, 1, trace_id=0, span_id=0,
+                                  close_ns=0)
+        # tolerant decode: malformed trace is dropped, envelope intact
+        bad = dict(hs)
+        bad[wire.TRACE_HEADER] = "not-a-trace"
+        assert wire.trace_from_headers(bad) is None
+        assert wire.envelope_from_headers(bad) == ("s", 1, 0, 1)
+        assert wire.trace_from_headers({}) is None
+        # zero trace_id = "no context" on the header arm too (pb and
+        # metadata arms already skip it) — an unconditional stamper
+        # must not produce a dangling-parent span tree
+        zero = dict(hs)
+        zero[wire.TRACE_HEADER] = "0:22"
+        assert wire.trace_from_headers(zero) is None
+
+    def test_http_proxy_front_passes_trace_headers_through(self):
+        """The HTTP proxy front must forward the trace headers with the
+        envelope — dropping them would cut the cross-tier span tree in
+        half at the proxy."""
+        import json as _json
+        import urllib.request
+
+        from veneur_tpu.cluster.discovery import StaticDiscoverer
+        from veneur_tpu.cluster.proxy import HttpProxyFront, ProxyServer
+
+        seen = []
+
+        class FakeDest:
+            def __init__(self, dest):
+                pass
+
+            def send_json(self, dicts, envelope=None):
+                seen.append(envelope)
+
+        proxy = ProxyServer(StaticDiscoverer(["a"]),
+                            refresh_interval_s=3600)
+        front = HttpProxyFront(proxy, dest_factory=FakeDest)
+        srv, port = front.start("127.0.0.1:0")
+        try:
+            headers = {"Content-Type": "application/json",
+                       "X-Veneur-Forward-Version": "jsonmetric-v1"}
+            headers.update(wire.envelope_headers(
+                "px", 5, 0, 1, trace_id=101, span_id=202,
+                close_ns=303))
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/import",
+                data=_json.dumps([{"name": "m", "type": "counter",
+                                   "tags": [], "value": 1}]).encode(),
+                headers=headers, method="POST")
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert resp.status == 200
+            assert len(seen) == 1
+            env = seen[0]
+            assert wire.envelope_from_headers(env) == ("px", 5, 0, 1)
+            assert wire.trace_from_headers(env) == (101, 202, 303)
+        finally:
+            srv.shutdown()
+
     def test_accepts_envelope_detection(self):
         def legacy(export):
             pass

@@ -358,6 +358,139 @@ def test_sender_kill_restart_bit_identical_to_oracle(tmp_path):
     assert "chaos.uniq" in names and "chaos.total" in names
 
 
+def test_scrape_loop_races_storm_and_kill_restart(tmp_path):
+    """ISSUE 8 satellite: a /debug/flush + /debug/fleet scrape loop
+    hammers BOTH tiers while a seeded ack-loss storm and a hard
+    sender kill-restart run underneath. Every response that arrives
+    must be parseable JSON with the expected top-level shape, and the
+    scraping must never stall the forward path: the storm completes
+    with exact totals at the global."""
+    import threading
+
+    reg = ResilienceRegistry()
+    glob, _gsink = _mk_global(reg)
+    clock = FakeClock()
+    rt = _RoundTransport()
+    base = f"http://127.0.0.1:{glob.http_api.port}"
+
+    def deliver(req):
+        return urllib.request.urlopen(req, timeout=5)
+
+    def mk_sender(registry):
+        egress = Egress(
+            "chaos-global",
+            policy=EgressPolicy(
+                retry=RetryPolicy(max_attempts=3, base_backoff_s=0.001,
+                                  max_backoff_s=0.002, deadline_s=120.0),
+                breaker=BreakerPolicy(failure_threshold=10_000)),
+            transport=rt, clock=clock, sleep=clock.sleep,
+            rng=random.Random(42), registry=registry)
+        inner = HttpJsonForwarder(base, timeout_s=5.0, max_per_body=3,
+                                  egress=egress)
+        journal = ForwardJournal(str(tmp_path), fsync="never")
+        fwd = ResilientForwarder(inner, destination="chaos-global",
+                                 sender_id="scrape-sender", seq_start=1,
+                                 journal=journal, registry=registry)
+        cfg = read_config(text=_SERVER_YAML)
+        cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+        cfg.http_address = "127.0.0.1:0"      # scrape surface
+        cfg.forward_address = "placeholder:1"
+        srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                     forwarder=fwd)
+        srv.start()
+        return srv, fwd
+
+    local, fwd = mk_sender(reg)
+
+    # -- the racing scraper: GETs both endpoints on both tiers until
+    # stopped; connection errors during the kill window are expected
+    # (the scraped process is "dead"), but every 200 body MUST parse
+    # with the expected shape
+    urls = {"local": f"http://127.0.0.1:{local.http_api.port}"}
+    stop = threading.Event()
+    scraped = {"n": 0, "bad": []}
+
+    def scrape_loop():
+        while not stop.is_set():
+            for tier in ("local", "global"):
+                root = base if tier == "global" else urls["local"]
+                for path in ("/debug/flush", "/debug/fleet"):
+                    try:
+                        with urllib.request.urlopen(root + path,
+                                                    timeout=5) as r:
+                            body = json.loads(r.read())
+                    except (OSError, urllib.error.URLError):
+                        continue      # kill window / restart race
+                    except Exception as e:    # unparseable = the bug
+                        scraped["bad"].append((tier, path, repr(e)))
+                        continue
+                    want = ("flight_recorder"
+                            if path == "/debug/flush" else "senders")
+                    if want not in body:
+                        scraped["bad"].append((tier, path, body))
+                    scraped["n"] += 1
+            time.sleep(0.002)
+
+    scraper = threading.Thread(target=scrape_loop, daemon=True)
+    scraper.start()
+
+    schedules = [
+        ["ok"],
+        seeded_schedule(104, 8, p_fail=0.6, ambiguous=True),
+        [503, 503, 503],                        # parks the interval
+        ["ok", "kill"],                         # replay lands, then die
+        ["ok"],                                 # recovered ladder ships
+        ["ok"],
+    ]
+    rng = np.random.default_rng(7)
+    reg2 = None
+    try:
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for r, schedule in enumerate(schedules):
+            rt.current = ScriptedTransport(schedule, clock,
+                                           deliver=deliver)
+            c.sendto(_round_lines(r, rng),
+                     ("127.0.0.1", local.bound_port()))
+            deadline = time.time() + 10
+            while local.packets_received < 1 and time.time() < deadline:
+                time.sleep(0.005)
+            assert local.packets_received >= 1, "datagram lost"
+            assert local.drain(10.0)
+            if r == 3:
+                with pytest.raises(SimulatedKill):
+                    local.flush_once(timestamp=1000 + r)
+                _hard_kill_local(local)
+                local.http_api.stop()
+                kill_journal_lock(fwd._journal)
+                reg2 = ResilienceRegistry()
+                local, fwd = mk_sender(reg2)
+                urls["local"] = \
+                    f"http://127.0.0.1:{local.http_api.port}"
+            else:
+                local.flush_once(timestamp=1000 + r)
+            clock.advance(10.0)
+        c.close()
+        assert glob.drain(10.0)
+        out = {m.name: m.value
+               for m in glob.flush_once(timestamp=9999)}
+        # scraping never stalled the forward path: exact totals
+        assert out["chaos.total"] == sum(range(1, 7))      # 21
+        assert out["chaos.extra"] == 2 * 6
+        assert fwd.pending_spill == 0
+        assert reg2.peek("chaos-global",
+                         "durability.recovered_intervals") > 0
+        # the scraper genuinely raced the storm, and every response
+        # that arrived was parseable with the right shape
+        stop.set()
+        scraper.join(10.0)
+        assert scraped["n"] >= 20, scraped
+        assert scraped["bad"] == []
+    finally:
+        stop.set()
+        local.stop()
+        glob.stop()
+
+
 def _mk_durable_global(tmp_path):
     cfg = read_config(text=_SERVER_YAML)
     cfg.http_address = "127.0.0.1:0"

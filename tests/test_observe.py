@@ -549,6 +549,668 @@ def test_default_registry_is_the_resilience_registry():
     assert resilience.ResilienceRegistry is TelemetryRegistry
 
 
+def _fake_trace_client():
+    class FakeClient:
+        def __init__(self):
+            self.spans = []
+
+        def record(self, span):
+            self.spans.append(span)
+            return True
+
+    return FakeClient()
+
+
+# ------------------------------------------------ fleet-scope tracing
+
+def test_fleetview_e2e_and_freshness_scripted_clock():
+    from veneur_tpu.observe import FleetView
+
+    clk = {"now": 1_000 * 10**9}
+    fv = FleetView(max_senders=4, window=16,
+                   clock=lambda: clk["now"])
+    # two chunks of one interval collapse onto one pending sample
+    fv.observe_interval("a", 7, close_ns=990 * 10**9)
+    fv.observe_interval("a", 7, close_ns=990 * 10**9)
+    fv.observe_interval("b", 3, close_ns=995 * 10**9)
+    out = fv.on_flush(1_000 * 10**9)
+    assert out == {"a": [10_000.0], "b": [5_000.0]}
+    fresh = fv.freshness(1_002 * 10**9)
+    assert fresh["a"] == 12 * 10**9 and fresh["b"] == 7 * 10**9
+    st = fv.debug_state(1_002 * 10**9)
+    row = st["senders"]["a"]
+    assert row["e2e_ms"] == {"count": 1, "p50": 10_000.0,
+                             "p99": 10_000.0}
+    assert row["freshness_age_ms"] == 12_000.0
+    assert row["intervals_merged"] == 1 and row["pending"] == 0
+    # a deduped chunk (close 0) bumps last-seen but never e2e
+    clk["now"] = 1_050 * 10**9
+    fv.observe_interval("a", 7, 0)
+    assert fv.on_flush(1_050 * 10**9) == {}
+    assert fv.debug_state(1_050 * 10**9)["senders"]["a"][
+        "last_seen_age_s"] == 0.0
+
+
+def test_fleetview_bounds_lru_and_pending_overflow():
+    from veneur_tpu.observe import FleetView
+    from veneur_tpu.observe.fleet import MAX_PENDING_INTERVALS
+
+    fv = FleetView(max_senders=2, window=8, clock=lambda: 10**9)
+    for i in range(5):
+        fv.observe_interval(f"s{i}", 1, close_ns=1)
+    assert fv.sender_count() == 2                  # LRU bound
+    fv2 = FleetView(max_senders=1, window=8, clock=lambda: 10**9)
+    for i in range(MAX_PENDING_INTERVALS + 10):
+        fv2.observe_interval("s", i, close_ns=1)
+    assert fv2.pending_dropped == 10
+    assert len(fv2.on_flush(10**9)["s"]) == MAX_PENDING_INTERVALS
+
+
+def test_e2e_timer_samples_are_local_only_and_sender_tagged():
+    from veneur_tpu.ingest.parser import LOCAL_ONLY
+    from veneur_tpu.observe import e2e_timer_samples
+
+    samples = e2e_timer_samples({"snd-1": [12.5, 80.0], "snd-2": [3.0]})
+    assert len(samples) == 3
+    assert all(m.scope == LOCAL_ONLY for m in samples)
+    assert all(m.key.name == "veneur.e2e.interval_latency_ms"
+               for m in samples)
+    assert {m.key.joined_tags for m in samples} == {"sender:snd-1",
+                                                    "sender:snd-2"}
+    assert all(m.key.type == "timer" for m in samples)
+
+
+def test_tick_pins_trace_identity_and_forward_stamps_it():
+    """The flush tick mints its trace identity at begin_tick; every
+    wire chunk the forward path emits while the tick runs carries that
+    identity plus the interval-close stamp (scripted timestamps stay
+    scripted), and emit_spans replays the SAME ids — the contract that
+    makes the receiver's parenting line up."""
+    from veneur_tpu.cluster import wire
+
+    reg = TelemetryRegistry()
+    seen_headers = []
+
+    def transport(req, timeout=None):
+        seen_headers.append(dict(req.header_items()))
+
+        class R:
+            status = 200
+
+            def read(self):
+                return b"{}"
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+        return R()
+
+    from veneur_tpu.cluster.forward import HttpJsonForwarder
+    clock = FakeClock()
+    egress = Egress("t", policy=EgressPolicy(), transport=transport,
+                    clock=clock, sleep=clock.sleep,
+                    rng=random.Random(1), registry=reg)
+    fwd = ResilientForwarder(
+        HttpJsonForwarder("http://t:1", timeout_s=5.0, egress=egress),
+        destination="t", sender_id="tr-sender", registry=reg)
+    cfg = read_config(text=_YAML)
+    cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    cfg.forward_address = "placeholder:1"
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                 span_sinks=[], forwarder=fwd)
+    srv.start()
+    try:
+        port = srv.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c.sendto(b"tr.c:1|c|#veneurglobalonly", ("127.0.0.1", port))
+        deadline = time.monotonic() + 10
+        while srv.packets_received < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.drain(10.0)
+        srv.flush_once(timestamp=1234)
+        c.close()
+        tick = srv.flight.last_tick()
+        assert tick.trace_id and tick.span_id
+        assert tick.close_ns == 1234 * 10**9
+        assert seen_headers, "no forward happened"
+        trace = wire.trace_from_headers(seen_headers[0])
+        assert trace == (tick.trace_id, tick.span_id, 1234 * 10**9)
+        # envelope identity rides alongside, unchanged
+        env = wire.envelope_from_headers(seen_headers[0])
+        assert env[0] == "tr-sender"
+        # span replay uses the SAME pinned ids
+        client = _fake_trace_client()
+        srv.flight.emit_spans(tick, client)
+        root = next(s for s in client.spans if s.name == "veneur.flush")
+        assert root.trace_id == tick.trace_id
+        assert root.id == tick.span_id and root.parent_id == 0
+    finally:
+        srv.stop()
+
+
+def test_recorder_off_stamps_no_trace_headers():
+    """flight_recorder: false -> no tick, no trace context on the wire
+    (legacy header set, byte-identical), and forwarding still works."""
+    from veneur_tpu.cluster import wire
+
+    reg = TelemetryRegistry()
+    seen = []
+
+    def transport(req, timeout=None):
+        seen.append(dict(req.header_items()))
+
+        class R:
+            status = 200
+
+            def read(self):
+                return b"{}"
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+        return R()
+
+    from veneur_tpu.cluster.forward import HttpJsonForwarder
+    clock = FakeClock()
+    egress = Egress("t", policy=EgressPolicy(), transport=transport,
+                    clock=clock, sleep=clock.sleep,
+                    rng=random.Random(1), registry=reg)
+    fwd = ResilientForwarder(
+        HttpJsonForwarder("http://t:1", timeout_s=5.0, egress=egress),
+        destination="t", sender_id="tr-sender", registry=reg)
+    cfg = read_config(text=_YAML)
+    cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    cfg.forward_address = "placeholder:1"
+    cfg.flight_recorder = False
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                 span_sinks=[], forwarder=fwd)
+    srv.start()
+    try:
+        port = srv.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c.sendto(b"tr.c:1|c|#veneurglobalonly", ("127.0.0.1", port))
+        deadline = time.monotonic() + 10
+        while srv.packets_received < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.drain(10.0)
+        srv.flush_once(timestamp=5)
+        c.close()
+        assert seen
+        assert wire.trace_from_headers(seen[0]) is None
+        assert wire.envelope_from_headers(seen[0])[0] == "tr-sender"
+        assert not any(k.lower().startswith("x-veneur-trace")
+                       for k in seen[0])
+    finally:
+        srv.stop()
+
+
+def test_import_observer_parents_spans_on_remote_trace():
+    """HTTP /import with a propagated trace context: the receiver's
+    dedupe/apply phases land in the import ring AND replay as SSF
+    spans carrying the SENDER's trace_id, rooted under the sender's
+    flush span id — one span tree across two processes."""
+    from veneur_tpu.cluster import wire
+
+    cfg = read_config(text=_YAML)
+    cfg.http_address = "127.0.0.1:0"
+    cfg.is_global = True
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[])
+    srv.trace_client = client = _fake_trace_client()
+    srv.start()
+    try:
+        port = srv.http_api.port
+        body = [{"name": "ft.c", "type": "counter", "tags": [],
+                 "value": 2}]
+        headers = {"Content-Type": "application/json",
+                   "X-Veneur-Forward-Version": "jsonmetric-v1"}
+        headers.update(wire.envelope_headers(
+            "remote-snd", 41, 0, 1, trace_id=777_000,
+            span_id=888_000, close_ns=900 * 10**9))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/import",
+            data=json.dumps(body).encode(), headers=headers,
+            method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.loads(resp.read()) == {"imported": 1}
+        # the ring record publishes AFTER the reply (scope __exit__)
+        deadline = time.monotonic() + 5
+        while srv.import_observer.flight.tick_count < 1 and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+        # the import tick recorded the request's phases
+        snap = srv.import_observer.flight.snapshot()
+        names = {p["name"] for p in snap[0]["phases"]}
+        assert {"decode", "dedupe", "apply", "request"} <= names
+        reqmeta = next(p for p in snap[0]["phases"]
+                       if p["name"] == "request")["meta"]
+        assert reqmeta["sender"] == "remote-snd"
+        assert reqmeta["seq"] == 41 and reqmeta["admitted"] is True
+        # and replayed as spans grafted under the REMOTE flush span
+        assert client.spans, "no import spans emitted"
+        assert all(s.trace_id == 777_000 for s in client.spans)
+        root = next(s for s in client.spans
+                    if s.name == "veneur.import")
+        assert root.parent_id == 888_000
+        child = next(s for s in client.spans
+                     if s.name == "veneur.import.apply")
+        assert child.parent_id == root.id
+        # a replayed chunk dedupes (200) and still records its phases
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.loads(resp.read()) == {"imported": 0,
+                                               "deduped": True}
+        deadline = time.monotonic() + 5
+        while srv.import_observer.flight.tick_count < 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+        snap = srv.import_observer.flight.snapshot()
+        reqmeta = next(p for p in snap[0]["phases"]
+                       if p["name"] == "request")["meta"]
+        assert reqmeta["admitted"] is False
+        # fleet view: the sender's interval is pending until a flush
+        assert srv.drain(10.0)
+        srv.flush_once(timestamp=960)
+        st = srv.fleet.debug_state()
+        row = st["senders"]["remote-snd"]
+        assert row["e2e_ms"]["count"] == 1
+        assert row["e2e_ms"]["p50"] == 60_000.0   # (960-900)s in ms
+        assert row["newest_close_ns"] == 900 * 10**9
+    finally:
+        srv.stop()
+
+
+def test_grpc_import_spans_carry_remote_trace():
+    """The gRPC arm: SendMetrics with an envelope + trace context in
+    the MetricList — receiver import spans carry the sender's ids."""
+    grpc = pytest.importorskip("grpc")
+    from veneur_tpu.cluster import wire
+    from veneur_tpu.cluster.forward import SEND_METRICS
+    from veneur_tpu.cluster.protos import forward_pb2, metric_pb2
+
+    cfg = read_config(text=_YAML)
+    cfg.grpc_listen_addresses = ["127.0.0.1:0"]
+    cfg.is_global = True
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[])
+    srv.trace_client = client = _fake_trace_client()
+    srv.start()
+    try:
+        m = metric_pb2.Metric(name="ft.g", type=metric_pb2.Counter)
+        m.counter.value = 3
+        ml = forward_pb2.MetricList(metrics=[m])
+        ml.envelope.CopyFrom(wire.envelope_pb(
+            "grpc-snd", 9, 0, 1, trace_id=1234, span_id=5678,
+            close_ns=10**9))
+        with grpc.insecure_channel(
+                f"127.0.0.1:{srv.grpc_port}") as ch:
+            send = ch.unary_unary(
+                SEND_METRICS,
+                request_serializer=forward_pb2.MetricList
+                .SerializeToString,
+                response_deserializer=forward_pb2.Empty.FromString)
+            send(ml, timeout=10)
+        assert client.spans
+        assert all(s.trace_id == 1234 for s in client.spans)
+        root = next(s for s in client.spans
+                    if s.name == "veneur.import")
+        assert root.parent_id == 5678
+        st = srv.fleet.debug_state()
+        assert "grpc-snd" in st["senders"]
+    finally:
+        srv.stop()
+
+
+def test_import_ring_private_records_survive_overload():
+    """Regression (review finding): handler threads record into
+    PRIVATE TickRecords published at request end — a ring slot handed
+    out at request START would be recycled out from under a slow
+    request once in-flight requests exceed ring capacity."""
+    from veneur_tpu.observe import ImportObserver
+
+    obs = ImportObserver(flight=FlightRecorder(capacity=2,
+                                               max_phases=16))
+    slow = obs.request(("slow", 1, 0, 1), None, "http")
+    slow.__enter__()
+    ph = slow.start("decode")
+    # a burst larger than ring capacity completes while slow is open
+    for i in range(5):
+        with obs.request(("fast", i, 0, 1), None, "http") as sc:
+            sc.admitted = True
+    slow.finish(ph, n_metrics=1)
+    slow.admitted = True
+    slow.__exit__(None, None, None)
+    # the slow request's record is intact and newest in the ring
+    newest = obs.flight.snapshot()[0]
+    req = next(p for p in newest["phases"] if p["name"] == "request")
+    assert req["meta"]["sender"] == "slow"
+    decode = next(p for p in newest["phases"] if p["name"] == "decode")
+    assert decode["end_ns"] is not None
+    assert obs.flight.tick_count == 6
+
+
+def test_rejected_import_never_bumps_fleet_last_seen():
+    """Regression (review finding): a request 400'd before a dedupe
+    verdict must NOT feed the fleet view — a sender whose every body
+    fails decode would otherwise look freshly alive on the very page
+    an operator consults to find it."""
+    from veneur_tpu.cluster import wire
+
+    cfg = read_config(text=_YAML)
+    cfg.http_address = "127.0.0.1:0"
+    cfg.is_global = True
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[])
+    srv.start()
+    try:
+        headers = {"Content-Type": "application/json",
+                   "X-Veneur-Forward-Version": "jsonmetric-v1"}
+        headers.update(wire.envelope_headers("bad-snd", 1, 0, 1))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.http_api.port}/import",
+            data=b"{not json", headers=headers, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+        # the ring record publishes AFTER the reply (scope __exit__):
+        # wait for the handler thread to finish the scope
+        deadline = time.monotonic() + 5
+        while srv.import_observer.flight.tick_count < 1 and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert "bad-snd" not in srv.fleet.debug_state()["senders"]
+        # the rejected request still left a readable ring record
+        snap = srv.import_observer.flight.snapshot()
+        reqmeta = next(p for p in snap[0]["phases"]
+                       if p["name"] == "request")["meta"]
+        assert reqmeta["admitted"] is False
+    finally:
+        srv.stop()
+
+
+def test_healthz_and_ready_verdicts():
+    """GET /healthz + /ready: structured verdicts; a wedged flusher
+    flips /healthz to 503 within HEALTH_STALL_INTERVALS of interval
+    (detectable from OUTSIDE the process), while degradation signals
+    (queue fill, breaker) mark status without failing the probe."""
+    srv, cap = _mk_server({"http_address": "127.0.0.1:0"})
+    try:
+        base = f"http://127.0.0.1:{srv.http_api.port}"
+        for path in ("/healthz", "/ready"):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                body = json.loads(r.read())
+            assert r.status == 200
+        assert body["healthy"] and body["ready"]
+        assert body["status"] == "ok"
+        assert body["checks"]["flush"]["ok"]
+        assert body["checks"]["queues"]["ok"]
+        # injectable clock: one interval late is NOT stalled ...
+        iv = srv.cfg.interval_seconds
+        now0 = srv._last_flush_ok
+        assert srv.health_state(now=now0 + 1.4 * iv)["healthy"]
+        # ... 1.5 intervals late IS — and the endpoint answers 503
+        v = srv.health_state(now=now0 + 1.6 * iv)
+        assert not v["healthy"] and v["status"] == "stalled"
+        assert not v["checks"]["flush"]["ok"]
+        srv._last_flush_ok -= 1.6 * iv
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "stalled"
+        # /ready flips on stop
+        srv._last_flush_ok = time.monotonic()
+        srv._stop.set()
+        assert not srv.health_state()["ready"]
+        srv._stop.clear()
+    finally:
+        srv.stop()
+
+
+def test_watchdog_counts_stalled_ticks():
+    """A wedged flusher increments veneur.watchdog.stalled_ticks_total
+    once per overdue interval — without the crash-only exit arm
+    (flush_watchdog_missed_flushes=0, the default)."""
+    cfg = Config(interval="0.05s", hostname="wd",
+                 tpu_histogram_slots=64, tpu_counter_slots=32,
+                 tpu_gauge_slots=32, tpu_set_slots=16)
+    srv = Server(cfg, sinks=[], plugins=[], span_sinks=[])
+    srv.flush_once = lambda *a, **k: time.sleep(3600)
+    srv.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if srv.telemetry.total(SERVER_SCOPE,
+                                   "watchdog.stalled_ticks") >= 2:
+                break
+            time.sleep(0.01)
+        total = srv.telemetry.total(SERVER_SCOPE,
+                                    "watchdog.stalled_ticks")
+        assert total >= 2
+        v = srv.health_state()
+        assert not v["healthy"]
+        assert v["checks"]["flush"]["stalled_ticks_total"] == total
+    finally:
+        srv._stop.set()
+        srv.stop()
+
+
+def test_debug_fleet_endpoint_both_tiers_view():
+    """GET /debug/fleet on a forwarding server: no fleet senders (it
+    receives nothing) but its OWN ladder summary; health rides along;
+    always parseable JSON."""
+    reg = TelemetryRegistry()
+    fwd = _scripted_forwarder(["refused"] * 3 + ["ok"] * 8, reg)
+    cfg = read_config(text=_YAML)
+    cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    cfg.http_address = "127.0.0.1:0"
+    cfg.forward_address = "placeholder:1"
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[],
+                 span_sinks=[], forwarder=fwd)
+    srv.start()
+    try:
+        port = srv.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c.sendto(b"fl.c:1|c|#veneurglobalonly", ("127.0.0.1", port))
+        deadline = time.monotonic() + 10
+        while srv.packets_received < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.drain(10.0)
+        srv.flush_once(timestamp=1)   # terminal failure parks (caught)
+        c.close()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http_api.port}/debug/fleet",
+                timeout=5) as resp:
+            st = json.loads(resp.read())
+        assert st["forward"]["ladder_depth"] == 1
+        assert st["forward"]["sender_id"] == "obs-sender"
+        assert "health" in st and "senders" in st
+        assert st["import_recorder"] is None or isinstance(
+            st["import_recorder"], dict)
+    finally:
+        srv.stop()
+
+
+def test_fleet_row_for_ledger_only_sender_has_full_shape():
+    """Regression (review finding): a sender known only from restored
+    dedupe watermarks (journal recovery, nothing forwarded yet this
+    incarnation) still gets the full documented /debug/fleet row shape
+    — a dashboard indexing row["e2e_ms"] must not KeyError on a
+    restarted fleet."""
+    cfg = read_config(text=_YAML)
+    cfg.http_address = "127.0.0.1:0"
+    cfg.is_global = True
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[])
+    srv.start()
+    try:
+        # watermark present with NO fleet-view traffic (bypasses the
+        # import observer, like a journal-restored watermark)
+        srv.dedupe_ledger.admit("ghost-snd", 7, 0, 1)
+        row = srv._debug_fleet_state()["senders"]["ghost-snd"]
+        assert row["dedupe_watermark"] == 7
+        assert row["e2e_ms"] == {"count": 0, "p50": 0.0, "p99": 0.0}
+        assert row["intervals_merged"] == 0 and row["pending"] == 0
+        assert row["freshness_age_ms"] is None
+    finally:
+        srv.stop()
+
+
+def test_phases_dropped_exported_as_self_metric():
+    """Ring overflow reaches the registry drain: a tick that drops
+    phases to the slot budget exports a nonzero
+    veneur.observe.phases_dropped_total, and the counter is
+    present-at-zero on clean ticks."""
+    srv, cap = _mk_server({"flight_recorder_max_phases": 8})
+    try:
+        _feed(srv, n_keys=8, n_per_key=4)
+        srv.flush_once(timestamp=1)
+        assert srv.flight.last_tick().dropped > 0
+        # counted after this tick's self-metric drain -> rides the NEXT
+        # flush body (like every end-of-tick counter)
+        assert srv.telemetry.peek(SERVER_SCOPE,
+                                  "observe.phases_dropped") > 0
+        srv.flush_once(timestamp=2)
+        cap.wait_for_flush(2)
+        m = next(m for m in cap.flushes[1]
+                 if m.name == "veneur.observe.phases_dropped_total")
+        assert m.value > 0
+        # present-at-zero on a clean-tick server
+        srv2, cap2 = _mk_server()
+        try:
+            srv2.flush_once(timestamp=1)
+            cap2.wait_for_flush(1)
+            m = next(m for m in cap2.flushes[0]
+                     if m.name == "veneur.observe.phases_dropped_total")
+            assert m.value == 0
+        finally:
+            srv2.stop()
+    finally:
+        srv.stop()
+
+
+def test_fanout_timers_flush_per_sink():
+    """flush_phase_timers grows per-sink fan-out children: each sink's
+    flush duration dogfoods as veneur.flush.phase.fanout.<sink> —
+    LOCAL-ONLY, from the sink's own thread."""
+    from veneur_tpu.observe import fanout_timer_sample
+
+    s = fanout_timer_sample("vendorx", 12.5)
+    from veneur_tpu.ingest.parser import LOCAL_ONLY
+    assert s.key.name == "veneur.flush.phase.fanout.vendorx"
+    assert s.scope == LOCAL_ONLY and s.key.type == "timer"
+
+    srv, cap = _mk_server()
+    try:
+        srv.flush_once(timestamp=1)
+        assert srv.drain(10.0)        # fanout samples land in workers
+        srv.flush_once(timestamp=2)
+        cap.wait_for_flush(2)
+        names = {m.name for m in cap.flushes[1]}
+        assert any(n.startswith(
+            "veneur.flush.phase.fanout." + cap.name())
+            for n in names), sorted(
+                n for n in names if "fanout" in n)
+    finally:
+        srv.stop()
+
+
+def test_two_tier_probe_one_span_tree_fleet_view_and_health():
+    """The acceptance probe: real UDP -> local Server -> real HTTP
+    forward -> global Server. One span tree spans both processes (the
+    receiver's import spans carry the SENDER's trace_id, rooted under
+    the sender's flush span), GET /debug/fleet on the global reports
+    per-sender e2e p50/p99 and freshness consistent with the scripted
+    clock, and /healthz flips unhealthy within 1.5 intervals of a
+    wedged flusher — all without changing a byte of merged state
+    (the exactly-once chaos oracles pin that half)."""
+    from veneur_tpu.cluster.forward import HttpJsonForwarder
+
+    cfg_g = read_config(text=_YAML)
+    cfg_g.http_address = "127.0.0.1:0"
+    cfg_g.is_global = True
+    glob = Server(cfg_g, sinks=[CaptureMetricSink()], plugins=[])
+    glob.trace_client = gclient = _fake_trace_client()
+    glob.start()
+
+    reg = TelemetryRegistry()
+    base = f"http://127.0.0.1:{glob.http_api.port}"
+    fwd = ResilientForwarder(
+        HttpJsonForwarder(base, timeout_s=5.0),
+        destination="probe-global", sender_id="probe-sender",
+        registry=reg)
+    cfg_l = read_config(text=_YAML)
+    cfg_l.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    cfg_l.forward_address = "placeholder:1"
+    local = Server(cfg_l, sinks=[CaptureMetricSink()], plugins=[],
+                   forwarder=fwd)
+    local.start()
+    try:
+        port = local.bound_port()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sender_traces = []
+        for r, close_ts in enumerate((1000, 1010)):
+            c.sendto(b"\n".join(
+                [b"probe.t:%d|ms" % (100 + r)]
+                + [b"probe.total:%d|c|#veneurglobalonly" % (r + 1)]),
+                ("127.0.0.1", port))
+            deadline = time.monotonic() + 10
+            while local.packets_received < 1 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert local.drain(10.0)
+            local.flush_once(timestamp=close_ts)
+            t = local.flight.last_tick()
+            sender_traces.append((t.trace_id, t.span_id))
+        c.close()
+        assert glob.drain(10.0)
+        merged = glob.flush_once(timestamp=1060)
+
+        # --- one span tree across both processes ---
+        assert gclient.spans, "global recorded no import spans"
+        import_roots = [s for s in gclient.spans
+                        if s.name == "veneur.import"]
+        got = {(s.trace_id, s.parent_id) for s in import_roots}
+        assert got == set(sender_traces)
+        # every IMPORT span joins its sender's trace (the global's own
+        # veneur.flush tree keeps its own local trace, as it should)
+        for s in gclient.spans:
+            if s.name.startswith("veneur.import"):
+                assert s.trace_id in {t for t, _ in sender_traces}
+
+        # --- merged state: trace context changed nothing ---
+        total = next(m for m in merged if m.name == "probe.total")
+        assert total.value == 3.0         # 1 + 2, exactly once
+
+        # --- /debug/fleet: e2e + freshness off the scripted clock ---
+        with urllib.request.urlopen(base + "/debug/fleet",
+                                    timeout=5) as resp:
+            st = json.loads(resp.read())
+        row = st["senders"]["probe-sender"]
+        # closes at 1000/1010, merged at 1060 -> 60s and 50s
+        assert row["e2e_ms"]["count"] == 2
+        assert row["e2e_ms"]["p50"] == 50_000.0
+        assert row["e2e_ms"]["p99"] == 60_000.0
+        assert row["newest_close_ns"] == 1010 * 10**9
+        assert row["intervals_merged"] == 2
+        assert row["dedupe_watermark"] >= 1
+        # the e2e timers dogfood as LOCAL-ONLY tenant metrics next tick
+        assert glob.drain(10.0)
+        merged2 = glob.flush_once(timestamp=1061)
+        assert any(m.name.startswith("veneur.e2e.interval_latency_ms")
+                   for m in merged2)
+
+        # --- /healthz flips on a wedged flusher ---
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            assert json.loads(r.read())["status"] in ("ok", "degraded")
+        glob._last_flush_ok -= 1.6 * glob.cfg.interval_seconds
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "stalled"
+    finally:
+        local.stop()
+        glob.stop()
+
+
 def test_storm_tick_records_fold_phases_in_the_ring():
     """ISSUE 7: a cardinality-storm tick shows its degradation IN the
     flight-recorder ring — an `overload` phase carrying the governor's

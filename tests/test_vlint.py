@@ -123,6 +123,29 @@ def test_tl01_out_of_scope_modules_unchecked():
     assert [v for v in run_paths([path]) if v.rule == "TL01"] == []
 
 
+def test_tr01_trace_literals_outside_wire():
+    # the hand-rolled trace header (7), close header (11), re-spelled
+    # lowercase read (16), and the gRPC metadata carrier key (20); the
+    # docstring mention, the suppressed diagnostic, and the envelope
+    # headers (TR01 covers only the TRACE context + the metadata
+    # carrier) all stay silent
+    assert lint("tr01_bad.py") == [("TR01", 7), ("TR01", 11),
+                                   ("TR01", 16), ("TR01", 20)]
+
+
+def test_tr01_allows_wire_itself():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "cluster", "wire.py")
+    assert [v for v in run_paths([path]) if v.rule == "TR01"] == []
+
+
+def test_tr01_out_of_scope_modules_unchecked():
+    # tooling outside veneur_tpu/ may name the headers freely
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "tools", "vlint", "py_checks.py")
+    assert [v for v in run_paths([path]) if v.rule == "TR01"] == []
+
+
 def test_ov01_uncounted_drop_verdicts():
     # the uncounted branch drop (12), the count-in-another-branch drop
     # (21) and the bare-return drop (39); the counted verdicts, the

@@ -73,4 +73,16 @@ DEFAULT_CONFIG = {
     "tl01_allow": (
         "veneur_tpu/observe/registry.py",
     ),
+    # TR01: where the trace-context wire-literal monopoly applies
+    # (path substring match; /tr01_ scopes the check's own fixture in)
+    # and the one module allowed to spell the forward trace headers /
+    # envelope metadata key — cluster/wire.py owns both directions of
+    # the encoding, like it owns the envelope codecs.
+    "tr01_scope": (
+        "veneur_tpu/",
+        "/tr01_",
+    ),
+    "tr01_allow": (
+        "veneur_tpu/cluster/wire.py",
+    ),
 }

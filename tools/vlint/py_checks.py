@@ -829,6 +829,21 @@ def check_dr01(mod: PyModule, config: dict) -> list[Violation]:
 _TL01_PREFIX = "veneur."
 
 
+def _docstring_ids(tree: ast.AST) -> set:
+    """ids of Constant nodes that are docstrings (the first statement
+    of a module/class/def) — literal-scanning checks exempt them."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                    body[0].value, ast.Constant) and isinstance(
+                    body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
 def check_tl01(mod: PyModule, config: dict) -> list[Violation]:
     """Self-metric naming monopoly: every `veneur.*` self-metric name
     in the serving tree must be minted by the unified telemetry
@@ -845,15 +860,7 @@ def check_tl01(mod: PyModule, config: dict) -> list[Violation]:
     if any(mod.path.endswith(a) for a in config["tl01_allow"]):
         return []
     # docstring Constants: the first statement of a module/class/def
-    docstrings = set()
-    for node in ast.walk(mod.tree):
-        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
-                             ast.AsyncFunctionDef)):
-            body = getattr(node, "body", [])
-            if body and isinstance(body[0], ast.Expr) and isinstance(
-                    body[0].value, ast.Constant) and isinstance(
-                    body[0].value.value, str):
-                docstrings.add(id(body[0].value))
+    docstrings = _docstring_ids(mod.tree)
     # constants living inside an f-string report via their JoinedStr
     fstring_parts = {id(v) for node in ast.walk(mod.tree)
                      if isinstance(node, ast.JoinedStr)
@@ -878,6 +885,47 @@ def check_tl01(mod: PyModule, config: dict) -> list[Violation]:
                 "observe/registry.py (TelemetryRegistry.drain / "
                 "phase_timer_samples / flush_span_name); count through "
                 "the registry or suppress with a reason"))
+    return out
+
+
+# ------------------------------------------------------------------- TR01
+
+# wire literals of the forward trace context + the envelope's gRPC
+# metadata carrier — matched case-insensitively, by prefix, so a
+# re-spelled header ("x-veneur-trace-parent") is still caught
+_TR01_PREFIXES = ("x-veneur-trace", "x-veneur-interval-close",
+                  "veneur-envelope-bin")
+
+
+def check_tr01(mod: PyModule, config: dict) -> list[Violation]:
+    """Trace-context wire-encoding monopoly: the header/metadata
+    literals that carry the forward trace context (and the envelope's
+    serialized-Envelope metadata key) may appear ONLY in
+    cluster/wire.py — the same single-home discipline as the envelope
+    codecs, for the same reason: two spellings of the encode/decode
+    mapping is how the sender and receiver drift apart silently (a
+    header renamed on one side reads as 'legacy peer, no trace' on the
+    other, and the span tree quietly falls in half). Docstrings are
+    exempt (documentation names headers)."""
+    if not any(m in mod.path for m in config["tr01_scope"]):
+        return []
+    if any(mod.path.endswith(a) for a in config["tr01_allow"]):
+        return []
+    docstrings = _docstring_ids(mod.tree)
+    out = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        if id(node) in docstrings:
+            continue
+        if node.value.lower().startswith(_TR01_PREFIXES):
+            out.append(Violation(
+                mod.path, node.lineno, "TR01",
+                f"trace-context wire literal {node.value!r} outside "
+                "cluster/wire.py — the envelope/trace header and "
+                "metadata encodings are single-homed there (use the "
+                "wire.* codec helpers), or suppress with a reason"))
     return out
 
 
@@ -962,5 +1010,6 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_sr02(mod, config))
     out.extend(check_dr01(mod, config))
     out.extend(check_tl01(mod, config))
+    out.extend(check_tr01(mod, config))
     out.extend(check_ov01(mod, config))
     return out

@@ -74,7 +74,10 @@ class GrpcForwarder:
             if envelope is not None:
                 batch.envelope.CopyFrom(wire.envelope_pb(
                     envelope.sender_id, envelope.interval_seq,
-                    envelope.chunk_offset + j, total))
+                    envelope.chunk_offset + j, total,
+                    trace_id=envelope.trace_id,
+                    span_id=envelope.span_id,
+                    close_ns=envelope.close_ns))
             try:
                 self._egress.call(self._send, batch,
                                   timeout_s=self.timeout_s,
@@ -213,7 +216,10 @@ class HttpJsonForwarder:
             if envelope is not None:
                 headers.update(wire.envelope_headers(
                     envelope.sender_id, envelope.interval_seq,
-                    envelope.chunk_offset + j, total))
+                    envelope.chunk_offset + j, total,
+                    trace_id=envelope.trace_id,
+                    span_id=envelope.span_id,
+                    close_ns=envelope.close_ns))
             req = urllib.request.Request(
                 self.url,
                 data=json.dumps(body[i:i + self.max_per_body]).encode(),

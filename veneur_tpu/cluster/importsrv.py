@@ -237,13 +237,20 @@ class ForwardHandler(grpc.GenericRpcHandler):
     """grpc.GenericRpcHandler serving forwardrpc.Forward."""
 
     def __init__(self, submit, ledger: DedupeLedger | None = None,
-                 registry: ResilienceRegistry | None = None):
+                 registry: ResilienceRegistry | None = None,
+                 observer=None):
         """`submit(worker_index_hash, ImportedMetric)` routes one metric;
         the Server provides a queue-backed implementation. `ledger`
-        (optional) dedupes envelope-bearing requests."""
+        (optional) dedupes envelope-bearing requests. `observer`
+        (optional, an observe.ImportObserver) records each request's
+        dedupe/apply phases in the import ring, replays them as SSF
+        spans parented on the remote sender's flush span, and feeds
+        the per-sender fleet view — observability only, it never
+        changes what is admitted or applied."""
         self._submit = submit
         self._ledger = ledger
         self._registry = registry or DEFAULT_REGISTRY
+        self._observer = observer
 
     def service(self, details):
         from .forward import SEND_METRICS, SEND_METRICS_V2
@@ -279,18 +286,55 @@ class ForwardHandler(grpc.GenericRpcHandler):
             return True
         return self._ledger.admit(*env)
 
+    def _apply(self, scope, env, metrics) -> None:
+        """The shared admit-then-route tail, phase-attributed."""
+        ph = scope.start("dedupe")
+        ok = self._admit(env)
+        scope.finish(ph, admitted=ok)
+        scope.admitted = ok
+        if not ok:
+            return
+        ph = scope.start("apply")
+        n = 0
+        for m in metrics:
+            self._route(m)
+            n += 1
+        scope.finish(ph, n_metrics=n)
+        scope.n_metrics = n
+
     def _send_metrics(self, request, context):
-        if self._admit(wire.envelope_from_metric_list(request)):
-            for m in request.metrics:
-                self._route(m)
+        env = wire.envelope_from_metric_list(request)
+        trace = wire.trace_from_metric_list(request)
+        obs = self._observer
+        if obs is None:
+            if self._admit(env):
+                for m in request.metrics:
+                    self._route(m)
+            return forward_pb2.Empty()
+        with obs.request(env, trace, "grpc") as scope:
+            self._apply(scope, env, request.metrics)
         return forward_pb2.Empty()
 
     def _send_metrics_v2(self, request_iterator, context):
         md = getattr(context, "invocation_metadata", None)
-        env = wire.envelope_from_metadata(md() if callable(md) else None)
+        md = md() if callable(md) else None
+        env = wire.envelope_from_metadata(md)
+        trace = wire.trace_from_metadata(md)
+        obs = self._observer
         if env is None or self._ledger is None:
-            for m in request_iterator:
-                self._route(m)
+            if obs is None:
+                for m in request_iterator:
+                    self._route(m)
+                return forward_pb2.Empty()
+            with obs.request(env, trace, "grpc-stream") as scope:
+                scope.admitted = True
+                ph = scope.start("apply")
+                n = 0
+                for m in request_iterator:
+                    self._route(m)
+                    n += 1
+                scope.finish(ph, n_metrics=n)
+                scope.n_metrics = n
             return forward_pb2.Empty()
         # materialize the stream BEFORE consulting the ledger: if the
         # client connection dies mid-stream the exception aborts the
@@ -300,20 +344,26 @@ class ForwardHandler(grpc.GenericRpcHandler):
         # retry away). The unary arm gets this for free — its request
         # is fully deserialized before the handler runs.
         metrics = list(request_iterator)
-        if self._ledger.admit(*env):
-            for m in metrics:
-                self._route(m)
+        if obs is None:
+            if self._ledger.admit(*env):
+                for m in metrics:
+                    self._route(m)
+            return forward_pb2.Empty()
+        with obs.request(env, trace, "grpc-stream") as scope:
+            self._apply(scope, env, metrics)
         return forward_pb2.Empty()
 
 
 def start_import_server(address: str, submit, max_workers: int = 8,
                         ledger: DedupeLedger | None = None,
-                        registry: ResilienceRegistry | None = None):
+                        registry: ResilienceRegistry | None = None,
+                        observer=None):
     """Bind a gRPC server for the Forward service; returns (server, port)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
-        (ForwardHandler(submit, ledger=ledger, registry=registry),))
+        (ForwardHandler(submit, ledger=ledger, registry=registry,
+                        observer=observer),))
     port = server.add_insecure_port(address)
     server.start()
     log.info("importsrv listening on %s", address)

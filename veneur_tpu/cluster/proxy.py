@@ -348,12 +348,16 @@ class HttpProxyFront:
                     self.send_response(400)
                     self.end_headers()
                     return
-                # idempotency envelope: forwarded verbatim to every
-                # destination's share (dedupe happens at the globals)
+                # idempotency envelope + trace context: forwarded
+                # verbatim to every destination's share (dedupe happens
+                # at the globals; dropping the trace headers here would
+                # cut the cross-tier span tree in half at the proxy)
                 env = {h: self.headers[h] for h in (
                     wire.ENVELOPE_SENDER_HEADER,
                     wire.ENVELOPE_SEQ_HEADER,
-                    wire.ENVELOPE_CHUNK_HEADER)
+                    wire.ENVELOPE_CHUNK_HEADER,
+                    wire.TRACE_HEADER,
+                    wire.TRACE_CLOSE_HEADER)
                     if self.headers.get(h) is not None}
                 errs = front.handle_batch(dicts, envelope=env or None)
                 self.send_response(502 if errs else 200)

@@ -35,21 +35,98 @@ ENVELOPE_SENDER_HEADER = "X-Veneur-Sender-Id"
 ENVELOPE_SEQ_HEADER = "X-Veneur-Interval-Seq"
 ENVELOPE_CHUNK_HEADER = "X-Veneur-Chunk"        # "<index>/<count>"
 
+# ---- fleet-tracing context (cross-tier span propagation) ----
+#
+# The sender's flush-tick trace identity (trace_id + root span id) and
+# interval-close wall time ride ALONGSIDE the envelope on both forward
+# contracts: as Envelope fields 5-7 on the forwardrpc arm (and inside
+# the serialized `veneur-envelope-bin` metadata of SendMetricsV2), as
+# the two headers below on jsonmetric-v1. Observability only — the
+# dedupe/apply path never reads them, a legacy peer ignores them, and
+# decode is TOLERANT (malformed trace context degrades to None; it
+# must never 400 a request whose envelope is fine). Like the envelope
+# codecs, the field<->header mapping lives ONLY here (vlint TR01).
+
+TRACE_HEADER = "X-Veneur-Trace-Id"              # "<trace_id>:<span_id>"
+TRACE_CLOSE_HEADER = "X-Veneur-Interval-Close-Ns"
+
 
 def envelope_pb(sender_id: str, interval_seq: int, chunk_index: int,
-                chunk_count: int):
+                chunk_count: int, trace_id: int = 0, span_id: int = 0,
+                close_ns: int = 0):
     return forward_pb2.Envelope(
         sender_id=sender_id, interval_seq=int(interval_seq),
-        chunk_index=int(chunk_index), chunk_count=int(chunk_count))
+        chunk_index=int(chunk_index), chunk_count=int(chunk_count),
+        trace_id=int(trace_id), span_id=int(span_id),
+        interval_close_ns=int(close_ns))
 
 
 def envelope_headers(sender_id: str, interval_seq: int, chunk_index: int,
-                     chunk_count: int) -> dict:
-    """The jsonmetric-v1 header encoding of one chunk's envelope."""
-    return {ENVELOPE_SENDER_HEADER: sender_id,
-            ENVELOPE_SEQ_HEADER: str(int(interval_seq)),
-            ENVELOPE_CHUNK_HEADER:
-                f"{int(chunk_index)}/{int(chunk_count)}"}
+                     chunk_count: int, trace_id: int = 0,
+                     span_id: int = 0, close_ns: int = 0) -> dict:
+    """The jsonmetric-v1 header encoding of one chunk's envelope (plus
+    its trace context, when the sender has one — zero trace_id emits
+    no trace headers, keeping legacy header sets byte-identical)."""
+    out = {ENVELOPE_SENDER_HEADER: sender_id,
+           ENVELOPE_SEQ_HEADER: str(int(interval_seq)),
+           ENVELOPE_CHUNK_HEADER:
+               f"{int(chunk_index)}/{int(chunk_count)}"}
+    if trace_id:
+        out[TRACE_HEADER] = f"{int(trace_id)}:{int(span_id)}"
+        if close_ns:
+            out[TRACE_CLOSE_HEADER] = str(int(close_ns))
+    return out
+
+
+def _header_get(headers, name):
+    v = headers.get(name)
+    # urllib's Request stores header keys str.capitalize()d;
+    # http.server's Message is case-insensitive already
+    return v if v is not None else headers.get(name.capitalize())
+
+
+def trace_from_headers(headers) -> tuple | None:
+    """(trace_id, span_id, close_ns) from jsonmetric-v1 headers, or
+    None. Tolerant: a malformed trace context is dropped (None), never
+    an error — trace loss must not cost an interval."""
+    raw = _header_get(headers, TRACE_HEADER)
+    if not raw:
+        return None
+    try:
+        tid, _, sid = str(raw).partition(":")
+        if not int(tid):
+            # zero trace_id means "no context" on every arm (the pb
+            # and metadata decoders skip it the same way) — a peer
+            # that stamps headers unconditionally must not produce a
+            # dangling-parent span tree here
+            return None
+        close = _header_get(headers, TRACE_CLOSE_HEADER)
+        return (int(tid), int(sid or 0), int(close or 0))
+    except ValueError:
+        return None
+
+
+def trace_from_metric_list(ml) -> tuple | None:
+    """Trace context of a forwardrpc.MetricList's envelope, or None."""
+    if not ml.HasField("envelope") or not ml.envelope.trace_id:
+        return None
+    e = ml.envelope
+    return (e.trace_id, e.span_id, e.interval_close_ns)
+
+
+def trace_from_metadata(metadata) -> tuple | None:
+    """Trace context of a SendMetricsV2 stream's invocation metadata,
+    or None (shares the envelope's serialized-Envelope carrier)."""
+    for key, value in metadata or ():
+        if key == ENVELOPE_METADATA_KEY:
+            try:
+                e = forward_pb2.Envelope.FromString(value)
+            except Exception:
+                return None
+            if e.trace_id:
+                return (e.trace_id, e.span_id, e.interval_close_ns)
+            return None
+    return None
 
 
 def envelope_from_headers(headers) -> tuple | None:
@@ -58,15 +135,9 @@ def envelope_from_headers(headers) -> tuple | None:
     when no envelope was sent (legacy senders — dedupe is skipped);
     raises ValueError on a malformed one (the receiver 400s rather than
     mis-applying it)."""
-    def _get(name):
-        v = headers.get(name)
-        # urllib's Request stores header keys str.capitalize()d;
-        # http.server's Message is case-insensitive already
-        return v if v is not None else headers.get(name.capitalize())
-
-    sender = _get(ENVELOPE_SENDER_HEADER)
-    seq = _get(ENVELOPE_SEQ_HEADER)
-    chunk = _get(ENVELOPE_CHUNK_HEADER)
+    sender = _header_get(headers, ENVELOPE_SENDER_HEADER)
+    seq = _header_get(headers, ENVELOPE_SEQ_HEADER)
+    chunk = _header_get(headers, ENVELOPE_CHUNK_HEADER)
     if sender is None and seq is None and chunk is None:
         return None
     if not sender or seq is None:

@@ -191,6 +191,15 @@ class Config:
     # capture is a debug action an operator must opt into.
     debug_flush_profile: bool = False
     debug_flush_profile_dir: str = "veneur-profile"
+    # Fleet-scope tracing, receiver half (observe/fleet.py): the
+    # per-sender e2e/freshness view behind GET /debug/fleet. Bounds:
+    # distinct sender ids tracked (LRU past the bound) and the rolling
+    # e2e sample window per sender serving the endpoint's p50/p99.
+    # Sender-side trace stamping needs no knob — it derives from the
+    # flight recorder's tick identity and encodes to nothing when the
+    # recorder is off.
+    fleet_max_senders: int = 1024
+    fleet_e2e_window: int = 256
 
     # --- TLS (statsd/SSF stream listeners) ---
     tls_key: str = ""
@@ -369,6 +378,10 @@ def _validate(cfg: Config) -> None:
             "flight_recorder_ticks must be >= 1 and "
             "flight_recorder_max_phases >= 8 (a tick's fixed phases "
             "alone need that many slots)")
+    if cfg.fleet_max_senders < 1 or cfg.fleet_e2e_window < 8:
+        raise ValueError(
+            "fleet_max_senders must be >= 1 and fleet_e2e_window >= 8 "
+            "(a p99 over fewer samples is noise)")
     for key in ("overload_max_keys_per_prefix", "overload_max_prefixes"):
         if getattr(cfg, key) < 1:
             raise ValueError(f"{key} must be >= 1")

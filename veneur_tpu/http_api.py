@@ -77,12 +77,24 @@ class HttpApi:
     imported metric onto a worker queue (the Server provides it)."""
 
     def __init__(self, address: str, submit=None, healthy=None,
-                 ledger=None, debug_state=None, profile=None):
+                 ledger=None, debug_state=None, profile=None,
+                 observer=None, fleet_state=None, health=None):
         """`debug_state()` (optional) returns the JSON-ready dict for
         GET /debug/flush; `profile(ticks)` (optional) schedules an
         on-demand jax.profiler capture — absent means the knob is off
         and the endpoint answers 403, so an operator can tell "not
-        enabled" from "not a server with an engine" (404)."""
+        enabled" from "not a server with an engine" (404).
+
+        `observer` (optional, observe.ImportObserver) phase-attributes
+        each POST /import and parents its spans on the remote sender's
+        flush span. `fleet_state()` serves GET /debug/fleet (the
+        per-sender e2e/freshness view). `health()` serves GET /healthz
+        and /ready with STRUCTURED verdicts (schema in README
+        "Observability"): a dict with `healthy`/`ready` booleans and a
+        per-check breakdown — unhealthy answers 503, so a wedged
+        flusher is detectable from OUTSIDE the process, not only by
+        absence of data. Without `health`, /healthz degrades to the
+        legacy boolean `healthy` callback."""
         host, _, port = address.rpartition(":")
         host = host.strip("[]") or "0.0.0.0"
         self._submit = submit
@@ -90,6 +102,9 @@ class HttpApi:
         self._ledger = ledger   # cluster.importsrv.DedupeLedger or None
         self._debug_state = debug_state
         self._profile = profile
+        self._observer = observer
+        self._fleet_state = fleet_state
+        self._health = health
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -110,6 +125,16 @@ class HttpApi:
                         self._reply(200, b"ok\n")
                     else:
                         self._reply(503, b"unhealthy\n")
+                elif self.path in ("/healthz", "/ready"):
+                    self._health_verdict(self.path == "/ready")
+                elif self.path.rstrip("/") == "/debug/fleet":
+                    if api._fleet_state is None:
+                        self._reply(404, b"no fleet state on this "
+                                         b"listener\n")
+                        return
+                    self._reply(200, json.dumps(
+                        api._fleet_state(), default=str).encode(),
+                        "application/json")
                 elif self.path == "/version":
                     self._reply(200, __version__.encode() + b"\n")
                 elif self.path == "/builddate":
@@ -127,6 +152,20 @@ class HttpApi:
                     self._debug_flush()
                 else:
                     self._reply(404, b"not found\n")
+
+            def _health_verdict(self, readiness: bool):
+                """GET /healthz | /ready: structured verdicts, 503 on
+                a failing verdict so supervisors/probes need no JSON
+                parsing — the body carries the why."""
+                if api._health is None:
+                    ok = bool(api._healthy())
+                    body = {"healthy": ok, "ready": ok, "checks": {}}
+                else:
+                    body = api._health()
+                ok = body.get("ready" if readiness else "healthy", False)
+                self._reply(200 if ok else 503,
+                            json.dumps(body, default=str).encode(),
+                            "application/json")
 
             def _debug_flush(self):
                 u = urlparse(self.path)
@@ -184,6 +223,18 @@ class HttpApi:
                     self._reply(400, f"bad forward envelope: "
                                      f"{e}\n".encode())
                     return
+                if api._observer is not None:
+                    # tolerant trace decode (None on malformed) + the
+                    # import ring / span-tree / fleet observation scope
+                    trace = wire.trace_from_headers(self.headers)
+                    with api._observer.request(env, trace,
+                                               "http") as scope:
+                        self._import_body(env, scope)
+                else:
+                    self._import_body(env, None)
+
+            def _import_body(self, env, scope):
+                ph = -1 if scope is None else scope.start("decode")
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n))
@@ -201,22 +252,37 @@ class HttpApi:
                                                key.joined_tags)
                         decoded.append((digest, pb))
                 except (ValueError, KeyError, TypeError) as e:
+                    if scope is not None:
+                        scope.finish(ph, outcome="error")
+                        scope.rejected = True
                     self._reply(400, f"bad import body: {e}\n".encode())
                     return
+                if scope is not None:
+                    scope.finish(ph, n_metrics=len(decoded))
                 # payload fully in hand: NOW consult the ledger — a
                 # chunk it has already admitted is dropped WHOLE, with
                 # a 200 (the sender delivered it, it just can't know
                 # that yet: the ambiguous-failure replay path)
-                if env is not None and api._ledger is not None \
-                        and not api._ledger.admit(*env):
+                ph = -1 if scope is None else scope.start("dedupe")
+                admitted = not (env is not None
+                                and api._ledger is not None
+                                and not api._ledger.admit(*env))
+                if scope is not None:
+                    scope.finish(ph, admitted=admitted)
+                    scope.admitted = admitted
+                if not admitted:
                     self._reply(200, json.dumps(
                         {"imported": 0, "deduped": True}).encode(),
                         "application/json")
                     return
+                ph = -1 if scope is None else scope.start("apply")
                 count = 0
                 for digest, pb in decoded:
                     api._submit(digest, pb)
                     count += 1
+                if scope is not None:
+                    scope.finish(ph, n_metrics=count)
+                    scope.n_metrics = count
                 self._reply(200, json.dumps({"imported": count}).encode(),
                             "application/json")
 

@@ -106,10 +106,20 @@ class _PhaseCtx:
 
 
 class TickRecord:
-    """One flush tick's phase tree (preallocated, reused by the ring)."""
+    """One flush tick's phase tree (preallocated, reused by the ring).
+
+    Each tick carries its own TRACE IDENTITY (`trace_id`, `span_id`),
+    pinned at begin_tick — not minted at span-emission time — so the
+    forward path can stamp the identity onto wire envelopes WHILE the
+    tick runs (cross-tier span propagation) and `emit_spans` later
+    replays the exact same tree the remote tier parented under.
+    `close_ns` is the interval-close wall time the tick represents
+    (the server stamps it; scripted timestamps stay scripted), riding
+    the same envelopes to feed the global's e2e latency accounting."""
 
     __slots__ = ("tick_id", "ts", "wall_start_ns", "mono_start", "mono_end",
-                 "n", "dropped", "_slots", "_lock")
+                 "n", "dropped", "_slots", "_lock",
+                 "trace_id", "span_id", "close_ns")
 
     def __init__(self, max_phases: int):
         self._slots = [_Phase() for _ in range(max_phases)]
@@ -121,8 +131,12 @@ class TickRecord:
         self.mono_end = 0
         self.n = 0
         self.dropped = 0
+        self.trace_id = 0
+        self.span_id = 0
+        self.close_ns = 0
 
     def _reset(self, tick_id: int, ts: int):
+        from ..trace import _span_id   # shared int63 id space
         self.tick_id = tick_id
         self.ts = ts
         self.wall_start_ns = time.time_ns()
@@ -130,6 +144,9 @@ class TickRecord:
         self.mono_end = 0
         self.n = 0
         self.dropped = 0
+        self.trace_id = _span_id()
+        self.span_id = _span_id()
+        self.close_ns = self.wall_start_ns
 
     # ---- hot path ----
 
@@ -260,6 +277,28 @@ class FlightRecorder:
     def end_tick(self, tick: TickRecord):
         tick.mono_end = time.monotonic_ns()
 
+    def open_tick(self, ts: int) -> TickRecord:
+        """A PRIVATE TickRecord outside the ring, for CONCURRENT
+        recorders (the import observer's handler threads): record into
+        it freely, then publish the finished record with adopt().
+        begin_tick would hand concurrent callers recycled ring slots —
+        with more in-flight requests than ring capacity, _reset wipes
+        a slot out from under the request still writing to it."""
+        tick = TickRecord(self.max_phases)
+        tick._reset(0, ts)      # tick_id assigned at adopt()
+        return tick
+
+    def adopt(self, tick: TickRecord):
+        """Publish a COMPLETED open_tick record into the ring (takes
+        the next slot; the recycled slot object is dropped). The tick
+        must be finished — end_tick first — since ring readers treat
+        membership as 'this tick happened'."""
+        with self._lock:
+            self._tick_count += 1
+            tick.tick_id = self._tick_count
+            self._ring[self._next] = tick
+            self._next = (self._next + 1) % self.capacity
+
     @property
     def tick_count(self) -> int:
         return self._tick_count
@@ -281,23 +320,36 @@ class FlightRecorder:
             out = out[:max(0, limit)]
         return out
 
-    def emit_spans(self, tick: TickRecord, client) -> int:
+    def emit_spans(self, tick: TickRecord, client, *,
+                   trace_id: int | None = None, parent_id: int = 0,
+                   namer=None) -> int:
         """Replay one tick as an SSF span tree through the trace
         client (the server's own ingest path — flusher.go parity).
-        Returns the number of spans enqueued."""
+        Returns the number of spans enqueued.
+
+        The root span uses the tick's OWN pinned identity (`trace_id`
+        defaults to tick.trace_id, root id is tick.span_id) — the same
+        identity the forward path stamped onto wire envelopes, so a
+        remote tier's import spans parent correctly. A receiver passes
+        `trace_id`/`parent_id` from the decoded envelope to graft its
+        import tick under the REMOTE sender's flush span, and `namer`
+        to name the tree (defaults to the flush span names)."""
         if client is None:
             return 0
         from ..ssf.protos import ssf_pb2
         from ..trace import _span_id
 
+        if namer is None:
+            namer = _registry.flush_span_name
         wall0 = tick.wall_start_ns
         mono0 = tick.mono_start
-        trace_id = _span_id()
-        root_id = _span_id()
+        trace_id = trace_id or tick.trace_id or _span_id()
+        root_id = tick.span_id or _span_id()
         end = tick.mono_end or time.monotonic_ns()
         root = ssf_pb2.SSFSpan(
-            version=0, trace_id=trace_id, id=root_id, parent_id=0,
-            name=_registry.flush_span_name(), service="veneur",
+            version=0, trace_id=trace_id, id=root_id,
+            parent_id=parent_id,
+            name=namer(), service="veneur",
             start_timestamp=wall0,
             end_timestamp=wall0 + (end - mono0))
         root.tags["tick_id"] = str(tick.tick_id)
@@ -311,7 +363,7 @@ class FlightRecorder:
             span = ssf_pb2.SSFSpan(
                 version=0, trace_id=trace_id, id=sid,
                 parent_id=ids.get(parent, root_id),
-                name=_registry.flush_span_name(name), service="veneur",
+                name=namer(name), service="veneur",
                 start_timestamp=wall0 + (t0 - mono0),
                 end_timestamp=wall0 + (t1 - mono0))
             sent += 1 if client.record(span) else 0

@@ -65,8 +65,9 @@ def metric_name(name: str, counter: bool) -> str:
 
 
 # component kinds whose scopes tag as themselves ("sink:datadog" ->
-# tag sink:datadog); anything else is a destination
-_COMPONENT_KINDS = ("sink:", "plugin:", "spansink:")
+# tag sink:datadog, "sender:<id>" -> the fleet view's per-sender
+# freshness/e2e gauges); anything else is a destination
+_COMPONENT_KINDS = ("sink:", "plugin:", "spansink:", "sender:")
 
 
 def scope_tags(scope: str) -> list:
@@ -244,3 +245,54 @@ def flush_span_name(phase_name: str | None = None) -> str:
     other self-metric names."""
     return "veneur.flush" if phase_name is None \
         else "veneur.flush." + phase_name
+
+
+def import_span_name(phase_name: str | None = None) -> str:
+    """SSF span names for the receiver's import tree (the cross-tier
+    half of one interval's span tree: these spans parent on the REMOTE
+    sender's flush span via the propagated trace context)."""
+    return "veneur.import" if phase_name is None \
+        else "veneur.import." + phase_name
+
+
+def fanout_timer_sample(sink_name: str, duration_ms: float):
+    """One sink's fan-out duration as a LOCAL-ONLY timer sample
+    (`veneur.flush.phase.fanout.<sink>`): the per-sink child of the
+    dogfood phase timers, emitted by the sink's OWN flush thread when
+    it finishes (the tick-end sampler would race sinks still in
+    flight). Local-only for the same reason as the phase timers: a
+    slow vendor's timing noise must never ride a forward envelope."""
+    from ..ingest.parser import LOCAL_ONLY, MetricKey, UDPMetric
+    from ..utils.hashing import metric_digest
+
+    mname = PHASE_TIMER_PREFIX + "fanout." + sink_name
+    key = MetricKey(mname, "timer", "")
+    return UDPMetric(
+        key=key, digest=metric_digest(mname, "timer", ""),
+        value=float(duration_ms), scope=LOCAL_ONLY)
+
+
+# End-to-end interval latency (close -> merged-into-flush at the
+# global), per sender. Timer samples dogfood through the engine like
+# the phase timers; the per-sender freshness watermark rides the
+# registry as a sender:-scoped gauge. Names minted here (TL01).
+E2E_TIMER_NAME = "veneur.e2e.interval_latency_ms"
+
+
+def e2e_timer_samples(per_sender_ms: dict) -> list:
+    """{sender_id: [latency_ms, ...]} -> LOCAL-ONLY timer samples
+    tagged sender:<id>, ready for Server._route_metric. LOCAL_ONLY is
+    load-bearing exactly as for phase timers: e2e bookkeeping must
+    never change forwarded state (the chaos oracles pin it)."""
+    from ..ingest.parser import LOCAL_ONLY, MetricKey, UDPMetric
+    from ..utils.hashing import metric_digest
+
+    out = []
+    for sender_id, samples in per_sender_ms.items():
+        tags = f"sender:{sender_id}"
+        key = MetricKey(E2E_TIMER_NAME, "timer", tags)
+        digest = metric_digest(E2E_TIMER_NAME, "timer", tags)
+        for ms in samples:
+            out.append(UDPMetric(key=key, digest=digest,
+                                 value=float(ms), scope=LOCAL_ONLY))
+    return out

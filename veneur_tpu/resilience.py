@@ -175,12 +175,23 @@ class ForwardEnvelope:
     response lost) cannot double-count. chunk_count == 0 lets the leaf
     compute the total from its own chunking (the whole-interval case);
     a replayed partial tail carries the ORIGINAL total so its chunk ids
-    line up with what the receiver already saw."""
+    line up with what the receiver already saw.
+
+    `trace_id`/`span_id`/`close_ns` are the fleet-tracing context
+    riding ALONGSIDE the identity (cluster/wire.py owns the wire
+    encoding): the sender's flush-tick trace + root span id — so the
+    receiver's import spans parent on the remote flush — and the
+    interval-close wall time feeding the global's e2e latency. Zeros
+    mean "no context" (recorder off, legacy sender) and encode to
+    nothing; the dedupe path never reads them."""
 
     sender_id: str
     interval_seq: int
     chunk_offset: int = 0
     chunk_count: int = 0
+    trace_id: int = 0
+    span_id: int = 0
+    close_ns: int = 0
 
 
 def accepts_envelope(fn) -> bool:
@@ -669,14 +680,22 @@ class _ReplayEntry:
     tail replay carries the same chunk ids the first send used, so the
     receiver's ledger can drop a chunk that was ambiguously applied."""
 
-    __slots__ = ("seq", "chunk_offset", "chunk_count", "export", "age")
+    __slots__ = ("seq", "chunk_offset", "chunk_count", "export", "age",
+                 "close_ns")
 
-    def __init__(self, seq, export, chunk_offset=0, chunk_count=0):
+    def __init__(self, seq, export, chunk_offset=0, chunk_count=0,
+                 close_ns=0):
         self.seq = seq
         self.export = export
         self.chunk_offset = chunk_offset
         self.chunk_count = chunk_count
         self.age = 0   # failed flushes survived (gauge eviction clock)
+        # ORIGINAL interval-close time: a replay re-stamps the current
+        # tick's trace ids (the replay runs inside this tick's span
+        # tree) but keeps the close time it was born with, so the
+        # global's e2e latency honestly includes replay-ladder delay.
+        # 0 = unknown (journal-recovered entries; e2e is skipped).
+        self.close_ns = close_ns
 
 
 class ResilientForwarder:
@@ -925,12 +944,14 @@ class ResilientForwarder:
         else:
             self.inner(export)
 
-    def _park(self, seq, export, chunk_offset=0, chunk_count=0):
+    def _park(self, seq, export, chunk_offset=0, chunk_count=0,
+              close_ns=0):
         n = _export_size(export)
         if n == 0:
             return 0
         self._entries.append(
-            _ReplayEntry(seq, export, chunk_offset, chunk_count))
+            _ReplayEntry(seq, export, chunk_offset, chunk_count,
+                         close_ns))
         self.registry.incr(self.destination, "spilled", n)
         self._enforce_ledger_budget()
         return n
@@ -972,6 +993,17 @@ class ResilientForwarder:
     def __call__(self, export):
         reg, dest = self.registry, self.destination
         replay_err = None
+        # fleet-tracing context from the tick in progress: every wire
+        # chunk this call emits (replays included) is stamped with the
+        # CURRENT tick's trace identity — the receiver parents its
+        # import spans under this flush — while close_ns keeps each
+        # interval's ORIGINAL close time (replay honesty). No tick
+        # (recorder off, library use) stamps nothing.
+        _sc0 = _current_scope()
+        _tick0 = _sc0.tick if _sc0 is not None else None
+        trace_id = _tick0.trace_id if _tick0 is not None else 0
+        span_id = _tick0.span_id if _tick0 is not None else 0
+        cur_close = _tick0.close_ns if _tick0 is not None else 0
         # -- durability write-ahead: the current interval enters the
         # journal (seq allocated now) BEFORE any wire traffic, so a
         # hard kill anywhere in this tick — mid-replay-ladder included
@@ -1000,7 +1032,9 @@ class ResilientForwarder:
                 break
             entry = self._entries[0]
             env = ForwardEnvelope(self.sender_id, entry.seq,
-                                  entry.chunk_offset, entry.chunk_count)
+                                  entry.chunk_offset, entry.chunk_count,
+                                  trace_id=trace_id, span_id=span_id,
+                                  close_ns=entry.close_ns)
             sc = _current_scope()
             tick = sc.tick if sc is not None else None
             rp = -1 if tick is None else \
@@ -1038,7 +1072,7 @@ class ResilientForwarder:
                 if cur_seq is None:
                     cur_seq = self._next_seq
                     self._next_seq += 1
-                self._park(cur_seq, export)
+                self._park(cur_seq, export, close_ns=cur_close)
             self._age_entries()
             self._jop("age")
             log.warning(
@@ -1073,7 +1107,9 @@ class ResilientForwarder:
         if tick is not None:
             tick.annotate(sp, seq=seq)
         try:
-            self._send(export, ForwardEnvelope(self.sender_id, seq))
+            self._send(export, ForwardEnvelope(
+                self.sender_id, seq, trace_id=trace_id,
+                span_id=span_id, close_ns=cur_close))
         except PartialDeliveryError as e:
             # some chunks landed: park only what didn't, resuming at
             # the failed chunk's id. The UPDATE record goes first so
@@ -1085,7 +1121,8 @@ class ResilientForwarder:
                       e.undelivered)
             n = self._park(seq, e.undelivered,
                            chunk_offset=e.delivered_chunks,
-                           chunk_count=e.chunk_count)
+                           chunk_count=e.chunk_count,
+                           close_ns=cur_close)
             self._age_entries()
             self._jop("age")
             log.warning(
@@ -1096,7 +1133,7 @@ class ResilientForwarder:
         except Exception as e:
             if tick is not None:
                 tick.finish(sp, outcome=type(e).__name__)
-            n = self._park(seq, export)
+            n = self._park(seq, export, close_ns=cur_close)
             self._age_entries()
             self._jop("age")
             log.warning(

@@ -257,6 +257,25 @@ class Server:
                 # data has had a full interval to land and flush — is
                 # safe to make a durable hard-drop floor
                 self._pending_watermarks: dict = {}
+        # Fleet-scope tracing, receiver half (observe/fleet.py): the
+        # per-sender e2e/freshness view plus the import observer that
+        # phase-attributes each import request and parents its spans on
+        # the remote sender's flush span. Built for the same servers
+        # that can receive forwards; observability only — admission and
+        # apply behavior is identical with it on or off.
+        self.fleet = None
+        self.import_observer = None
+        if cfg.grpc_listen_addresses or cfg.http_address or cfg.is_global:
+            self.fleet = observe.FleetView(
+                max_senders=cfg.fleet_max_senders,
+                window=cfg.fleet_e2e_window)
+            import_ring = None
+            if cfg.flight_recorder:
+                import_ring = observe.FlightRecorder(
+                    capacity=cfg.flight_recorder_ticks, max_phases=16)
+            self.import_observer = observe.ImportObserver(
+                fleet=self.fleet, flight=import_ring,
+                client=lambda: self.trace_client)
         self._grpc_servers = []
         # tags_exclude strips tag names BEFORE key construction (metrics
         # differing only in an excluded tag aggregate together), in both
@@ -311,6 +330,7 @@ class Server:
         self._stream_conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
         self._stop = threading.Event()
+        self._started = False        # flipped at the end of start()
         self._last_flush_ok = time.monotonic()
         # Flight recorder: the bounded ring of per-tick phase trees
         # behind /debug/flush, SSF self-tracing, and the
@@ -624,11 +644,17 @@ class Server:
                              daemon=True)
         t.start()
         self._threads.append(t)
-        if self.cfg.flush_watchdog_missed_flushes > 0:
-            t = threading.Thread(target=self._watchdog, name="watchdog",
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+        # the watchdog thread ALWAYS runs: it counts overdue ticks
+        # (veneur.watchdog.stalled_ticks_total — the outside-visible
+        # stall signal behind /healthz) every interval; the crash-only
+        # exit stays gated on flush_watchdog_missed_flushes > 0
+        t = threading.Thread(target=self._watchdog, name="watchdog",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        # vlint: disable=TH01 reason=monotonic one-way flag; readers
+        # (health probes) tolerate either order around startup
+        self._started = True
 
     def stop(self, *, grace: float | None = None, clock=time.monotonic,
              sleep=time.sleep):
@@ -1059,7 +1085,8 @@ class Server:
                 self._count("worker.dropped")
 
         server, port = start_import_server(
-            addr, submit, ledger=self.dedupe_ledger)
+            addr, submit, ledger=self.dedupe_ledger,
+            observer=self.import_observer)
         self._grpc_servers.append(server)
         self.grpc_port = port
 
@@ -1082,6 +1109,9 @@ class Server:
         self.http_api = HttpApi(
             addr, submit=submit, ledger=self.dedupe_ledger,
             debug_state=self._debug_flush_state,
+            observer=self.import_observer,
+            fleet_state=self._debug_fleet_state,
+            health=self.health_state,
             # the profiler trigger only exists when the operator opted
             # in via debug_flush_profile (a capture is a debug action)
             profile=(self.request_profile_capture
@@ -1260,6 +1290,14 @@ class Server:
         tick = token = None
         if self.flight is not None:
             tick = self.flight.begin_tick(ts)
+            if timestamp is not None:
+                # scripted/explicit timestamps stay scripted all the
+                # way through the e2e accounting: the interval-close
+                # stamp the forward envelopes carry (and the fleet
+                # view's merge clock) derives from the SAME value, so
+                # close->merged latency is deterministic under the
+                # fault harness's pinned clocks
+                tick.close_ns = int(timestamp * 1_000_000_000)
             token = observe.set_current_tick(tick)
         self._maybe_profile_start()
         try:
@@ -1293,6 +1331,13 @@ class Server:
             # THIS tick's phases, flushed like any tenant metric
             for m in observe.phase_timer_samples(tick):
                 self._route_metric(m)
+        if tick is not None and tick.dropped:
+            # ring-overflow export: phases the slot budget dropped are
+            # counted in the tick AND surfaced as a self-metric
+            # (veneur.observe.phases_dropped_total, drained next
+            # interval) so attribution gaps are visible in dashboards,
+            # not only to a /debug/flush reader
+            self._count("observe.phases_dropped", tick.dropped)
         self.telemetry.incr_level(observe.SERVER_SCOPE, "flush.count")
         return frameset
 
@@ -1362,6 +1407,28 @@ class Server:
             checks.extend(ch)
         if tick is not None:
             tick.finish(ep)
+
+        if self.fleet is not None:
+            # e2e boundary: every interval admitted before this drain
+            # is now merged into flushed state — turn the pending close
+            # stamps into close->merged latency samples. The timers
+            # dogfood through the engine NEXT tick (like phase timers)
+            # and are LOCAL-ONLY; the freshness watermark rides the
+            # registry as a per-sender gauge. One-interval fuzz for
+            # chunks still in a worker queue at drain time — the same
+            # documented fuzz as the dedupe watermark journal.
+            fp = -1 if tick is None else tick.start("fleet")
+            now_ns = (tick.close_ns if tick is not None
+                      else int(ts) * 1_000_000_000)
+            e2e = self.fleet.on_flush(now_ns)
+            for sid, age in self.fleet.freshness(now_ns).items():
+                self.telemetry.set_gauge(f"sender:{sid}",
+                                         "e2e.freshness_age_ns", age)
+            for m in observe.e2e_timer_samples(e2e):
+                self._route_metric(m)
+            if tick is not None:
+                tick.finish(fp, senders=len(e2e),
+                            intervals=sum(len(v) for v in e2e.values()))
 
         tp = -1 if tick is None else tick.start("telemetry")
         frameset = FrameSet(
@@ -1584,6 +1651,127 @@ class Server:
             }
         return state
 
+    # health verdict threshold: a flush is STALLED once its lag exceeds
+    # this many intervals (1.5 = the check flips within one interval of
+    # the first missed tick, without flapping on ordinary jitter)
+    HEALTH_STALL_INTERVALS = 1.5
+
+    def health_state(self, now: float | None = None,
+                     fwd_state: dict | None = None) -> dict:
+        """Structured verdicts for GET /healthz and /ready. `healthy`
+        is the hard bit — false ONLY when the flush loop is stalled
+        (the crash-only failure mode made observable from outside);
+        the remaining checks are degradation signals (breaker open,
+        journal degraded, governor shedding, queue fill) that flag
+        `status: degraded` without failing the probe — supervisors
+        must not restart a server that is correctly load-shedding.
+        `now` is injectable (fault harness); `fwd_state` lets a caller
+        that already computed the forwarder's debug_state (the
+        /debug/fleet page embeds this verdict) pass it in instead of
+        rebuilding the per-entry ladder list."""
+        now = time.monotonic() if now is None else now
+        interval = self.cfg.interval_seconds
+        lag = now - self._last_flush_ok
+        started = self._started
+        stalled = started and lag > self.HEALTH_STALL_INTERVALS * interval
+        checks = {
+            "flush": {"ok": not stalled, "lag_s": round(lag, 3),
+                      "interval_s": interval,
+                      "stalled_ticks_total": self.telemetry.total(
+                          observe.SERVER_SCOPE, "watchdog.stalled_ticks")},
+        }
+        fwd = self.forwarder
+        if fwd_state is not None or hasattr(fwd, "debug_state"):
+            # same introspection path /debug/flush and /debug/fleet
+            # consume — ONE owner of the forwarder-internals dig
+            st = fwd_state if fwd_state is not None else fwd.debug_state()
+            bstate = st["breaker_state"]
+            pending = st["pending_spill"]
+            checks["forward"] = {
+                "ok": bstate != "open" and not pending,
+                "breaker_state": bstate,
+                "pending_spill": pending,
+                "ladder_depth": len(st["ladder"]),
+            }
+        degraded_journals = []
+        if self.cfg.durability_enabled:
+            if (self._forward_journal is not None
+                    and getattr(self.forwarder, "_journal", None) is None
+                    and isinstance(self.forwarder,
+                                   resilience.ResilientForwarder)):
+                degraded_journals.append("forward")
+            if self.dedupe_ledger is not None \
+                    and self._dedupe_journal is None:
+                degraded_journals.append("dedupe_watermarks")
+            checks["journal"] = {"ok": not degraded_journals,
+                                 "degraded": degraded_journals}
+        if self.admission is not None:
+            rate = self.admission.shed_rate
+            checks["overload"] = {"ok": rate >= 1.0, "shed_rate": rate}
+        qfill = max((q.qsize() / q.maxsize for q in self.worker_queues),
+                    default=0.0)
+        checks["queues"] = {"ok": qfill < 0.9, "fill": round(qfill, 4)}
+        degraded = any(not c["ok"] for c in checks.values())
+        return {
+            "healthy": not stalled,
+            "ready": started and not self._stop.is_set(),
+            "status": ("stalled" if stalled
+                       else "degraded" if degraded else "ok"),
+            "checks": checks,
+        }
+
+    def _debug_fleet_state(self) -> dict:
+        """GET /debug/fleet payload: the per-sender fleet view (e2e
+        p50/p99, freshness, last-seen, dedupe watermark) on a receiving
+        tier, this server's OWN forward ladder summary (depth, replay
+        ages, spill, breaker) on a sending tier, the bounded import
+        ring, and the health verdict — the one page that answers
+        'which sender is stalled, which interval is stuck in a replay
+        ladder, how stale is the global's view'."""
+        now_ns = time.time_ns()
+        senders: dict = {}
+        if self.fleet is not None:
+            fleet = self.fleet.debug_state(now_ns)
+            senders = fleet["senders"]
+        if self.dedupe_ledger is not None:
+            for sid, mark in self.dedupe_ledger.max_admitted().items():
+                # a sender known only from restored watermarks (journal
+                # recovery, no forward yet this incarnation) still gets
+                # the FULL documented row shape — a dashboard indexing
+                # row["e2e_ms"] must not crash on a restarted fleet
+                senders.setdefault(sid, {
+                    "last_seen_age_s": None,
+                    "newest_close_ns": 0,
+                    "freshness_age_ms": None,
+                    "intervals_merged": 0,
+                    "pending": 0,
+                    "e2e_ms": {"count": 0, "p50": 0.0, "p99": 0.0},
+                })["dedupe_watermark"] = mark
+        forward = None
+        fwd_state = None
+        fwd = self.forwarder
+        if hasattr(fwd, "debug_state"):
+            fwd_state = fwd.debug_state()
+            ages = [e["age"] for e in fwd_state["ladder"]]
+            forward = {
+                "sender_id": fwd_state["sender_id"],
+                "ladder_depth": len(fwd_state["ladder"]),
+                "replay_ages": ages,
+                "oldest_replay_age": max(ages, default=0),
+                "pending_spill": fwd_state["pending_spill"],
+                "breaker_state": fwd_state["breaker_state"],
+            }
+        obs = self.import_observer
+        return {
+            "now_ns": now_ns,
+            "flush_count": self.flush_count,
+            "senders": senders,
+            "forward": forward,
+            "import_recorder": (obs.debug_state() if obs is not None
+                                else None),
+            "health": self.health_state(fwd_state=fwd_state),
+        }
+
     def _self_metrics(self, ts: int, t0: float,
                       eng_stats: dict | None = None) -> list[InterMetric]:
         """veneur.* self-telemetry: stage the per-tick gauges/deltas
@@ -1596,8 +1784,13 @@ class Server:
         # the pre-unification attribute drain always did
         for name in ("packet.received", "packet.error", "worker.dropped",
                      "ssf.received", "ssf.error", "flush.error",
-                     "import.rejected"):
+                     "import.rejected", "watchdog.stalled_ticks"):
             tel.mark(S, name, 0)
+        if self.flight is not None:
+            # ring-overflow accounting reports every interval; its
+            # steady-state ZERO is the signal that phase attribution
+            # is complete (no phases dropped to the slot budget)
+            tel.mark(S, "observe.phases_dropped", 0)
         if self.native_bridge is not None:
             # UDP in native mode is counted in the bridge; fold in the
             # per-interval deltas. Drop taxonomy: ring/backpressure
@@ -1726,6 +1919,7 @@ class Server:
         handles); a sink still running when the flush tick ends shows
         `in_flight` in /debug/flush — the wedged-vendor signature."""
         tel = self.telemetry
+        phase_timers = self.cfg.flush_phase_timers
 
         def spawn(key, target):
             prev = self._sink_inflight.get(key)
@@ -1770,19 +1964,31 @@ class Server:
                     count = 0
                     if ok:
                         count = n if isinstance(n, int) else len(frameset)
+                    dur_s = time.monotonic() - t0
                     scope = f"sink:{sink.name()}"
                     tel.mark(scope, "sink.metrics_flushed", count)
                     tel.set_gauge(scope, "sink.flush_duration_ns",
-                                  (time.monotonic() - t0) * 1e9)
+                                  dur_s * 1e9)
                     tel.mark(scope, "sink.flush_errors", 0 if ok else 1)
                     if tick is not None:
                         tick.finish(ph, sink=sink.name(), ok=ok,
                                     flushed=count)
+                        if phase_timers:
+                            # per-sink fan-out child timer
+                            # (veneur.flush.phase.fanout.<sink>):
+                            # emitted HERE, by the sink's own thread,
+                            # because the tick-end dogfood sampler
+                            # would race sinks still in flight — a
+                            # slow vendor is exactly the one a
+                            # tick-end sample would miss
+                            self._route_metric(observe.fanout_timer_sample(
+                                sink.name(), dur_s * 1e3))
             spawn(("sink", s.name()), run)
         for p in self.plugins:
             def runp(plugin=p):
                 ph = -1 if tick is None else \
                     tick.start("plugin.flush", parent)
+                t0 = time.monotonic()
                 ok = True
                 try:
                     plugin.flush_frames(frameset, self.hostname)
@@ -1792,11 +1998,16 @@ class Server:
                 finally:
                     if tick is not None:
                         tick.finish(ph, plugin=plugin.name(), ok=ok)
+                        if phase_timers:
+                            self._route_metric(observe.fanout_timer_sample(
+                                plugin.name(),
+                                (time.monotonic() - t0) * 1e3))
             spawn(("plugin", p.name()), runp)
         for ss in self.span_sinks:
             def runs(sink=ss):
                 ph = -1 if tick is None else \
                     tick.start("spansink.flush", parent)
+                t0 = time.monotonic()
                 ok = True
                 try:
                     sink.flush()
@@ -1807,6 +2018,10 @@ class Server:
                 finally:
                     if tick is not None:
                         tick.finish(ph, sink=sink.name(), ok=ok)
+                        if phase_timers:
+                            self._route_metric(observe.fanout_timer_sample(
+                                sink.name(),
+                                (time.monotonic() - t0) * 1e3))
             spawn(("spansink", ss.name()), runs)
 
     def _start_profiling(self):
@@ -1832,13 +2047,21 @@ class Server:
     # ------------- watchdog -------------
 
     def _watchdog(self):
-        """Crash-only supervision: exit hard if flushes stop completing
-        (Server.FlushWatchdog panics after watchdog_max_ticks)."""
-        max_lag = (self.cfg.flush_watchdog_missed_flushes
-                   * self.cfg.interval_seconds)
-        while not self._stop.wait(self.cfg.interval_seconds):
+        """Stall accounting + crash-only supervision. Every interval
+        the watchdog compares now against the last COMPLETED flush;
+        an overdue tick increments veneur.watchdog.stalled_ticks_total
+        (a wedged flusher is detectable from outside the process —
+        /healthz and the counter — instead of only by absence of
+        data). The hard exit (Server.FlushWatchdog panics after
+        watchdog_max_ticks) stays opt-in via
+        flush_watchdog_missed_flushes."""
+        interval = self.cfg.interval_seconds
+        max_lag = (self.cfg.flush_watchdog_missed_flushes * interval)
+        while not self._stop.wait(interval):
             lag = time.monotonic() - self._last_flush_ok
-            if lag > max_lag:
+            if lag > interval:
+                self._count("watchdog.stalled_ticks")
+            if max_lag > 0 and lag > max_lag:
                 log.critical(
                     "flush watchdog: no completed flush in %.1fs "
                     "(max %.1fs) — exiting for supervisor restart",
