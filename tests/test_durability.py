@@ -580,3 +580,313 @@ class TestForwarderRecovery:
         assert fwd._entries == []
         assert set(os.listdir(tmp_path)) == before
         assert [c[2] for c in inner.calls] == ["refused", "ok", "ok"]
+
+
+# ------------------------------- engine checkpoint/restore (ISSUE 9)
+#
+# The global tier's engine-state records: codec roundtrips must be
+# BIT-exact (raw-leaf framing: NaN payloads, -0.0, inf all survive),
+# a checkpoint+restore cycle must flush bit-identically to the
+# uncrashed engine, the delta encoding must serialize only dirty
+# piles, and the torn-write/bit-flip fuzz contract extends to the new
+# record kinds.
+
+def _mk_engine(**kw):
+    from veneur_tpu.models.pipeline import (AggregationEngine,
+                                            EngineConfig)
+    cfg = dict(histogram_slots=64, counter_slots=32, gauge_slots=32,
+               set_slots=16, batch_size=32, buffer_depth=32,
+               hll_precision=6, percentiles=(0.5, 0.99),
+               aggregates=("min", "max", "count"), is_global=True)
+    cfg.update(kw)
+    eng = AggregationEngine(EngineConfig(**cfg))
+    eng.enable_dirty_tracking()
+    return eng
+
+
+def _feed_engine(eng, seed=0, n=6):
+    rng = np.random.default_rng(seed)
+    for k in range(n):
+        m = int(rng.integers(2, 30))
+        means = np.sort(rng.normal(50 + k, 9, m).astype(np.float32))
+        weights = rng.uniform(0.5, 3.0, m).astype(np.float32)
+        eng.import_histogram(
+            MetricKey(f"e.h{k % 3}", "timer", "a:b"), means, weights,
+            float(means.min()), float(means.max()),
+            float((means * weights).sum()), float(weights.sum()),
+            float(rng.uniform(0, 2)))
+        eng.import_counter(MetricKey(f"e.c{k % 2}", "counter", ""),
+                           float(rng.uniform(0, 100)))
+        eng.import_gauge(MetricKey("e.g", "gauge", ""),
+                         float(rng.normal()))
+        eng.import_set(MetricKey("e.s", "set", ""),
+                       rng.integers(0, 30, 1 << 6).astype(np.uint8))
+
+
+def _flush_rows(eng, ts=777):
+    res = eng.flush(timestamp=ts)
+    return sorted(
+        (m.name, tuple(m.tags), str(m.type), m.value)
+        for m in res.metrics)
+
+
+def _roundtrip_checkpoint(snap, engine_idx=0, n_engines=1):
+    """encode -> frame -> decode, like recovery would see it."""
+    recs = drec.encode_engine_checkpoint(engine_idx, n_engines, snap)
+    meta = keys = None
+    banks, staged = {}, {}
+    keys = {}
+    for rec_type, payload in recs:
+        if rec_type == drec.REC_ENGINE_META:
+            meta = drec.decode_engine_meta(payload)
+        elif rec_type == drec.REC_ENGINE_KEYS:
+            _i, kind, interval, entries = \
+                drec.decode_engine_keys(payload)
+            keys[kind] = (interval, entries)
+        elif rec_type == drec.REC_ENGINE_BANK:
+            _i, kind, ids, leaves = drec.decode_engine_bank(payload)
+            banks[kind] = (ids, leaves)
+        elif rec_type == drec.REC_ENGINE_STAGED:
+            _i, staged = drec.decode_engine_staged(payload)
+    return meta, keys, banks, staged
+
+
+class TestEngineRecords:
+    def test_engine_import_roundtrip_with_envelope(self):
+        from veneur_tpu.cluster import wire
+        ms = wire.export_to_metrics(mk_export(3))
+        env = ("sender-1", 42, 1, 3)
+        payload = drec.encode_engine_import(9, ms, env)
+        op_id, back, env2 = drec.decode_engine_import(payload)
+        assert op_id == 9 and env2 == env
+        assert [m.SerializeToString() for m in back] == \
+            [m.SerializeToString() for m in ms]
+
+    def test_engine_import_roundtrip_without_envelope(self):
+        from veneur_tpu.cluster import wire
+        ms = wire.export_to_metrics(mk_export(1))
+        op_id, back, env = drec.decode_engine_import(
+            drec.encode_engine_import(3, ms))
+        assert op_id == 3 and env is None
+        assert len(back) == len(ms)
+
+    def test_engine_meta_roundtrip(self):
+        fpr = (512, 256, 256, 512, 512, 256, 1 << 14, 100.0)
+        payload = drec.encode_engine_meta(2, 4, 77, 13, fpr)
+        assert drec.decode_engine_meta(payload) == (2, 4, 77, 13, fpr)
+
+    def test_engine_keys_roundtrip(self):
+        entries = [(5, 1, 9, "a.b", "timer", "x:y,z:w"),
+                   (0, 0, 0, "c", "counter", "")]
+        payload = drec.encode_engine_keys(1, drec.BANK_HISTO, 11,
+                                          entries)
+        assert drec.decode_engine_keys(payload) == \
+            (1, drec.BANK_HISTO, 11, entries)
+
+    def test_engine_bank_rows_bit_exact(self):
+        """Raw-leaf framing must survive every f32 bit pattern — NaN
+        payloads, -0.0, inf — verified on the u32 view."""
+        rng = np.random.default_rng(8)
+        ids = np.array([3, 7, 50], np.int32)
+        leaves = {
+            "mean": rng.integers(0, 2**32, (3, 16),
+                                 dtype=np.uint32).view(np.float32),
+            "weight": rng.uniform(0, 5, (3, 16)).astype(np.float32),
+            "buf_value": rng.normal(size=(3, 8)).astype(np.float32),
+            "buf_weight": rng.uniform(0, 1, (3, 8)).astype(np.float32),
+            "buf_n": rng.integers(0, 8, 3).astype(np.int32),
+            "vmin": np.array([np.inf, -0.0, np.nan], np.float32),
+            "vmax": np.array([-np.inf, 1e38, -1e-40], np.float32),
+            "vsum": rng.normal(size=3).astype(np.float32),
+            "count": rng.uniform(0, 9, 3).astype(np.float32),
+            "recip": rng.normal(size=3).astype(np.float32),
+            "vsum_lo": rng.normal(size=3).astype(np.float32),
+            "count_lo": rng.normal(size=3).astype(np.float32),
+            "recip_lo": rng.normal(size=3).astype(np.float32),
+        }
+        payload = drec.encode_engine_bank(0, drec.BANK_HISTO, ids,
+                                          leaves)
+        _i, kind, ids2, leaves2 = drec.decode_engine_bank(payload)
+        assert kind == drec.BANK_HISTO
+        np.testing.assert_array_equal(ids, ids2)
+        for name in drec.HISTO_LEAVES:
+            a, b = leaves[name], leaves2[name]
+            assert a.dtype == b.dtype and a.shape == b.shape
+            if a.dtype == np.float32:
+                np.testing.assert_array_equal(a.view(np.uint32),
+                                              b.view(np.uint32))
+            else:
+                np.testing.assert_array_equal(a, b)
+
+    def test_engine_staged_roundtrip(self):
+        rng = np.random.default_rng(4)
+        staged = {
+            "centroids": [
+                (7, rng.normal(size=5).astype(np.float32),
+                 rng.uniform(0, 2, 5).astype(np.float32),
+                 1.0, 9.0, 22.5, 5.0, 0.25)],
+            "sets": [(2, rng.integers(0, 40, 64).astype(np.uint8))],
+            "counters": [(3, 1.0000000001), (9, -7.25)],
+            "gauges": [(1, 2.5)],
+        }
+        _i, back = drec.decode_engine_staged(
+            drec.encode_engine_staged(5, staged))
+        assert back["counters"] == staged["counters"]   # exact f64
+        assert back["gauges"] == staged["gauges"]
+        (s, m, w, *scalars) = back["centroids"][0]
+        assert s == 7 and tuple(scalars) == (1.0, 9.0, 22.5, 5.0, 0.25)
+        np.testing.assert_array_equal(m, staged["centroids"][0][1])
+        np.testing.assert_array_equal(w, staged["centroids"][0][2])
+        np.testing.assert_array_equal(back["sets"][0][1],
+                                      staged["sets"][0][1])
+
+
+class TestEngineCheckpointRestore:
+    def test_restore_flushes_bit_identical(self):
+        """THE engine-level criterion: checkpoint an engine mid-
+        interval, restore into a fresh engine, and both must flush
+        bit-identical state — then keep ingesting into both and the
+        NEXT flush must also match (restored rows re-marked dirty,
+        staged accumulators intact)."""
+        a = _mk_engine()
+        _feed_engine(a, seed=1)
+        snap = _roundtrip_checkpoint(a.checkpoint_state())
+        meta, keys, banks, staged = snap
+        _idx, _n, wm, gseq, fpr = meta
+        b = _mk_engine()
+        b.restore_checkpoint(fpr, gseq, wm, keys, banks, staged)
+        assert _flush_rows(a) == _flush_rows(b)
+        # continue the interval on both: restored state must compose
+        _feed_engine(a, seed=2)
+        _feed_engine(b, seed=2)
+        assert _flush_rows(a, ts=778) == _flush_rows(b, ts=778)
+
+    def test_checkpoint_after_flush_roundtrips(self):
+        """The server's actual cadence: checkpoint AFTER the flush
+        swap (banks mostly fresh, interner carrying the keys)."""
+        a = _mk_engine()
+        _feed_engine(a, seed=3)
+        a.flush(timestamp=100)
+        _feed_engine(a, seed=4, n=2)      # post-swap touches
+        meta, keys, banks, staged = _roundtrip_checkpoint(
+            a.checkpoint_state())
+        _idx, _n, wm, gseq, fpr = meta
+        b = _mk_engine()
+        b.restore_checkpoint(fpr, gseq, wm, keys, banks, staged)
+        _feed_engine(a, seed=5, n=2)
+        _feed_engine(b, seed=5, n=2)
+        assert _flush_rows(a) == _flush_rows(b)
+
+    def test_delta_serializes_under_10pct_when_10pct_touched(self):
+        """Acceptance gate: touch < 10% of slots, and the checkpoint
+        serializes < 10% of piles — the delta encoding's whole
+        point."""
+        eng = _mk_engine(histogram_slots=512, counter_slots=256,
+                         gauge_slots=256, set_slots=256)
+        for k in range(20):               # 20/512 histo slots
+            eng.import_histogram(
+                MetricKey(f"d.h{k}", "timer", ""),
+                np.array([1.0, 2.0], np.float32),
+                np.array([1.0, 1.0], np.float32), 1.0, 2.0, 3.0, 2.0,
+                1.5)
+        with eng.lock:
+            eng._flush_import_centroids()
+        snap = eng.checkpoint_state()
+        assert snap["piles_dirty"] <= 20
+        assert snap["piles_total"] == 512 + 256 + 256 + 256
+        assert snap["piles_dirty"] / snap["piles_total"] < 0.10
+        # and the encoded records carry exactly the dirty rows
+        recs = drec.encode_engine_checkpoint(0, 1, snap)
+        rows = 0
+        for rec_type, payload in recs:
+            if rec_type == drec.REC_ENGINE_BANK:
+                _i, _k, ids, _l = drec.decode_engine_bank(payload)
+                rows += len(ids)
+        assert rows == snap["piles_dirty"]
+
+    def test_fingerprint_mismatch_refuses(self):
+        a = _mk_engine()
+        _feed_engine(a, seed=1, n=2)
+        meta, keys, banks, staged = _roundtrip_checkpoint(
+            a.checkpoint_state())
+        _idx, _n, wm, gseq, fpr = meta
+        b = _mk_engine(histogram_slots=128)    # different shape
+        with pytest.raises(ValueError, match="fingerprint"):
+            b.restore_checkpoint(fpr, gseq, wm, keys, banks, staged)
+
+    def test_dirty_bitmap_resets_at_swap(self):
+        eng = _mk_engine()
+        _feed_engine(eng, seed=6, n=3)
+        with eng.lock:
+            eng._flush_import_centroids()
+            eng._flush_import_sets()
+            eng._flush_import_scalars()
+        assert eng.dirty_stats()[0] > 0
+        eng.flush(timestamp=50)
+        assert eng.dirty_stats()[0] == 0
+
+
+class TestEngineJournalFuzz:
+    """The torn-write/bit-flip contract extended to the engine record
+    kinds: recovery never raises and yields a bit-exact PREFIX whose
+    every record still decodes."""
+
+    def _engine_journal(self, tmp_path):
+        from veneur_tpu.cluster import wire
+        from veneur_tpu.durability import EngineJournal
+        ej = EngineJournal(str(tmp_path), fsync="never")
+        ej.journal.load()
+        eng = _mk_engine()
+        written = []
+        for op in range(1, 6):
+            ms = wire.export_to_metrics(mk_export(op))
+            payload = drec.encode_engine_import(
+                op, ms, ("s", op, 0, 1))
+            ej.append_import(payload)
+            written.append((drec.REC_ENGINE_IMPORT, payload))
+            _feed_engine(eng, seed=op, n=2)
+            recs = drec.encode_engine_checkpoint(
+                0, 1, eng.checkpoint_state())
+            ej.append_checkpoint(recs)
+            written.extend(recs)
+        ej.close()
+        return ej.journal.journal_path, written
+
+    def _decode_all(self, recs):
+        for rec_type, payload in recs:
+            if rec_type == drec.REC_ENGINE_IMPORT:
+                drec.decode_engine_import(payload)
+            elif rec_type == drec.REC_ENGINE_META:
+                drec.decode_engine_meta(payload)
+            elif rec_type == drec.REC_ENGINE_KEYS:
+                drec.decode_engine_keys(payload)
+            elif rec_type == drec.REC_ENGINE_BANK:
+                drec.decode_engine_bank(payload)
+            elif rec_type == drec.REC_ENGINE_STAGED:
+                drec.decode_engine_staged(payload)
+            elif rec_type == drec.REC_ENGINE_COMMIT:
+                drec.decode_engine_commit(payload)
+
+    def test_truncation_prefix_only(self, tmp_path):
+        path, written = self._engine_journal(tmp_path)
+        blob = open(path, "rb").read()
+        for cut in range(HEADER_BYTES, len(blob),
+                         max(1, len(blob) // 300)):
+            recs, _end, _torn = decode_frames(blob[:cut], HEADER_BYTES)
+            assert recs == written[:len(recs)]
+            self._decode_all(recs)       # every surviving record decodes
+
+    def test_bit_flip_prefix_only(self, tmp_path):
+        path, written = self._engine_journal(tmp_path)
+        blob = bytearray(open(path, "rb").read())
+        rng = random.Random(17)
+        for _ in range(200):
+            i = rng.randrange(HEADER_BYTES, len(blob))
+            bit = 1 << rng.randrange(8)
+            blob[i] ^= bit
+            recs, _end, torn = decode_frames(bytes(blob), HEADER_BYTES)
+            assert recs == written[:len(recs)]
+            if len(recs) < len(written):
+                assert torn
+            self._decode_all(recs)
+            blob[i] ^= bit

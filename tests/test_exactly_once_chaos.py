@@ -533,10 +533,12 @@ def test_receiver_kill_restart_drops_ancient_replays(tmp_path):
         glob.flush_once(timestamp=2)
     finally:
         # hard kill: listeners down, NO graceful journal close (only
-        # the process lock drops, as a real SIGKILL would drop it)
+        # the process locks drop, as a real SIGKILL would drop them —
+        # the engine journal holds one too since ISSUE 9)
         glob._stop.set()
         glob.http_api.stop()
         kill_journal_lock(glob._dedupe_journal)
+        kill_journal_lock(glob._engine_journal)
         for s in glob._sockets + glob._listen_socks:
             try:
                 s.close()
@@ -575,3 +577,302 @@ def test_durability_disabled_default_is_inert(tmp_path, monkeypatch):
         assert os.listdir(tmp_path) == []
     finally:
         srv.stop()
+
+
+# =====================================================================
+# Global-tier kill-restart chaos (ISSUE 9): the engine journal under a
+# hard GLOBAL kill mid-interval, in a real two-tier UDP -> forward
+# topology. The restarted global must flush state BIT-IDENTICAL to a
+# zero-crash oracle AND keep deduping ancient replays.
+# =====================================================================
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _mk_durable_global_fixed(tmp, port: int, reg: ResilienceRegistry):
+    cfg = read_config(text=_SERVER_YAML)
+    cfg.http_address = f"127.0.0.1:{port}"
+    cfg.is_global = True
+    cfg.durability_enabled = True
+    cfg.durability_dir = str(tmp)
+    cfg.durability_fsync = "never"
+    srv = Server(cfg, sinks=[CaptureMetricSink()], plugins=[])
+    # count duplicate drops into the test's registry WITHOUT replacing
+    # the ledger — engine recovery re-seeded it with the admitted
+    # envelopes, and discarding that state is exactly the double-count
+    # bug this suite exists to catch
+    srv.dedupe_ledger._registry = reg
+    srv.start()
+    return srv
+
+
+def _hard_kill_global(srv):
+    """SIGKILL simulation for the GLOBAL: listeners down, no graceful
+    close — the journal locks drop with the fds, everything else the
+    next incarnation must learn from the bytes on disk."""
+    srv._stop.set()
+    try:
+        srv.http_api.stop()
+    except Exception:
+        pass
+    kill_journal_lock(srv._engine_journal)
+    kill_journal_lock(srv._dedupe_journal)
+    for s in srv._sockets + srv._listen_socks:
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def _run_global_kill(tmp_path, kill: bool, seed: int = 7):
+    """Drive the two-tier topology; the GLOBAL is hard-killed after
+    admitting seq 3 MID-INTERVAL (its merged state exists only in the
+    write-ahead engine journal — the prior flush boundary's checkpoint
+    covers seqs 1-2) and restarts from the journal on the same port.
+
+    Round script (seq = round + 1):
+      r0  ok                      seq 1 admitted
+      r1  ok                      seq 2 admitted
+      --- global flush tick (delta checkpoint covers 1-2) ---
+      r2  ok                      seq 3 admitted, NOT yet flushed
+      r3  503,503,503             seq 4 parks at the sender
+      --- [kill arm] hard-kill global; restart from journal ---
+      r4  ack_lost, ok...         replay seq 4 (chunk applied at the
+                                  RESTARTED global, ack lost, retry
+                                  deduped) then seq 5
+      r5  ok                      seq 6
+    Returns (mid-flush rows, final rows, dup count, recovery stats).
+    """
+    reg = ResilienceRegistry()
+    gport = _free_port()
+    glob = _mk_durable_global_fixed(tmp_path, gport, reg)
+    clock = FakeClock()
+    rt = _RoundTransport()
+    egress = Egress(
+        "chaos-global",
+        policy=EgressPolicy(
+            retry=RetryPolicy(max_attempts=4, base_backoff_s=0.001,
+                              max_backoff_s=0.002, deadline_s=120.0),
+            breaker=BreakerPolicy(failure_threshold=10_000)),
+        transport=rt, clock=clock, sleep=clock.sleep,
+        rng=random.Random(42), registry=reg)
+    base = f"http://127.0.0.1:{gport}"
+    inner = HttpJsonForwarder(base, timeout_s=5.0, max_per_body=3,
+                              egress=egress)
+
+    def deliver(req):
+        return urllib.request.urlopen(req, timeout=5)
+
+    fwd = ResilientForwarder(inner, destination="chaos-global",
+                             sender_id="gk-sender", seq_start=1,
+                             registry=reg)
+    local = _mk_local(fwd)
+    schedules = [
+        ["ok"],
+        ["ok"],
+        ["ok"],
+        [503, 503, 503, 503],
+        ["ack_lost", "ok"],
+        ["ok"],
+    ]
+    rng = np.random.default_rng(seed)
+    mid = None
+    recovery = None
+    try:
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for r, schedule in enumerate(schedules):
+            if r == 2:
+                # the global's own flush boundary: the delta
+                # checkpoint that makes seqs 1-2 part of a
+                # self-contained snapshot group
+                assert glob.drain(10.0)
+                mid = sorted(
+                    (m.name, tuple(m.tags), str(m.type), m.value)
+                    for m in glob.flush_once(timestamp=500)
+                    if not m.name.startswith("veneur."))
+            if r == 4 and kill:
+                _hard_kill_global(glob)
+                glob = _mk_durable_global_fixed(tmp_path, gport, reg)
+                recovery = glob._recovery
+                # ancient replays still dedupe after restart: seq 3
+                # was recovered from the write-ahead log, seq 1 from
+                # the pre-checkpoint window — both must be refused
+                for old_seq in (1, 3):
+                    assert _post_import(
+                        gport,
+                        [{"name": "gk.probe", "type": "counter",
+                          "tags": [], "value": 1}],
+                        "gk-sender", old_seq, chunk=0, count=3) == \
+                        {"imported": 0, "deduped": True}
+            rt.current = ScriptedTransport(schedule, clock,
+                                           deliver=deliver)
+            c.sendto(_round_lines(r, rng),
+                     ("127.0.0.1", local.bound_port()))
+            deadline = time.time() + 10
+            while local.packets_received < 1 and time.time() < deadline:
+                time.sleep(0.005)
+            assert local.packets_received >= 1, "datagram lost"
+            assert local.drain(10.0)
+            local.flush_once(timestamp=1000 + r)
+            clock.advance(10.0)
+        c.close()
+        assert glob.drain(10.0)
+        out = sorted(
+            (m.name, tuple(m.tags), str(m.type), m.value)
+            for m in glob.flush_once(timestamp=9999)
+            if not m.name.startswith("veneur."))
+        dups = reg.peek("import", "forward.duplicates_dropped")
+        assert fwd.pending_spill == 0
+    finally:
+        local.stop()
+        glob.stop()
+    return mid, out, dups, recovery
+
+
+def test_global_kill_restart_bit_identical_to_oracle(tmp_path):
+    """THE ISSUE 9 acceptance criterion: hard-kill the GLOBAL
+    mid-interval under a real two-tier UDP -> forward topology,
+    restart it from the engine journal, and its full flushed state —
+    every t-digest percentile and aggregate, HLL set estimate, and
+    counter sum — is BIT-IDENTICAL to a zero-crash oracle run over
+    the same traffic and fault schedule, with ancient replays still
+    deduped after the restart (asserted inside the run) and the
+    recovered-op accounting visible."""
+    mid_c, crash, dups, recovery = _run_global_kill(
+        tmp_path / "crash", kill=True)
+    mid_o, oracle, oracle_dups, _ = _run_global_kill(
+        tmp_path / "oracle", kill=False)
+    # recovery genuinely restored checkpoint state AND replayed the
+    # write-ahead ops the checkpoint didn't cover
+    assert recovery is not None
+    assert recovery["engines_restored"] >= 1
+    assert recovery["ops_replayed"] >= 1
+    # the dedupe ledger fired at the RESTARTED global (the ack-lost
+    # retry) — and the oracle saw the same schedule, so both count
+    assert dups > 0 and oracle_dups > 0
+    # the pre-kill flush boundary agreed too
+    assert mid_c == mid_o
+    # THE criterion: bit-identical, no approx
+    assert crash == oracle
+    names = {n for n, _t, _ty, _v in crash}
+    assert any(n.endswith(".50percentile") for n in names)
+    assert "chaos.uniq" in names and "chaos.total" in names
+
+
+def test_ready_reports_recovering_and_debug_flush_checkpoint_block(
+        tmp_path):
+    """ISSUE 9 satellites: a durable global constructed (recovery ran
+    in __init__) but not yet serving reports a structured `recovering`
+    verdict on the readiness probe; once started, /ready flips and
+    GET /debug/flush serves the checkpoint block (generation, bytes,
+    dirty/total ratio, last-snapshot age, restore stats)."""
+    glob = _mk_durable_global(tmp_path)
+    glob.stop()
+    glob2cfg = read_config(text=_SERVER_YAML)
+    glob2cfg.http_address = "127.0.0.1:0"
+    glob2cfg.is_global = True
+    glob2cfg.durability_enabled = True
+    glob2cfg.durability_dir = str(tmp_path)
+    glob2cfg.durability_fsync = "never"
+    glob2 = Server(glob2cfg, sinks=[CaptureMetricSink()], plugins=[])
+    try:
+        h = glob2.health_state()
+        assert h["status"] == "recovering"
+        assert h["ready"] is False
+        assert h["checks"]["recovery"]["in_progress"] is True
+        glob2.start()
+        h2 = glob2.health_state()
+        assert h2["ready"] is True
+        assert h2["status"] == "ok"
+        assert h2["checks"]["recovery"]["ok"] is True
+        out = {m.name: m.value
+               for m in glob2.flush_once(timestamp=1)}  # -> a checkpoint
+        # veneur.durability.engine_* self-metrics are present-at-zero
+        # while the feature is armed (a zero IS the steady-state
+        # signal: armed, nothing degraded, nothing skipped)
+        for name in (
+                "veneur.durability.engine_delta_skipped_piles_total",
+                "veneur.durability.engine_recovered_ops_total",
+                "veneur.durability.engine_recovered_metrics_total",
+                "veneur.durability.engine_snapshot_piles_dirty",
+                "veneur.durability.engine_snapshot_piles_total",
+                "veneur.durability.engine_snapshot_bytes",
+                "veneur.durability.engine_restore_ns"):
+            assert name in out, name
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{glob2.http_api.port}/debug/flush",
+            timeout=5).read())
+        blk = body["durability"]["engine_checkpoint"]
+        assert blk["enabled"] is True
+        for key in ("generation", "journal_bytes",
+                    "last_snapshot_bytes", "piles_dirty",
+                    "piles_total", "dirty_ratio",
+                    "last_checkpoint_age_s", "restore"):
+            assert key in blk, key
+    finally:
+        glob2.stop()
+
+
+def test_torn_checkpoint_group_falls_back_to_previous(tmp_path):
+    """A crash mid-append can leave one checkpoint group's META frame
+    on disk without the KEYS/BANK rows (each record is its own CRC'd
+    frame): recovery must NOT restore that partial group — its
+    watermark would suppress the op replay that backs the missing
+    rows, silently losing the interval. The group-commit marker makes
+    recovery fall back to the previous COMMITTED group and replay the
+    ops above its watermark instead."""
+    from veneur_tpu.durability import records as drec
+    from veneur_tpu.durability.journal import (HEADER_BYTES,
+                                               decode_frames,
+                                               encode_frame)
+    body = [{"name": "tg.c", "type": "counter", "tags": [], "value": 5}]
+    glob = _mk_durable_global(tmp_path)
+    try:
+        port = glob.http_api.port
+        assert _post_import(port, body, "tg", 1) == {"imported": 1}
+        assert glob.drain(10.0)
+        glob.flush_once(timestamp=1)       # C1, committed
+        assert _post_import(port, body, "tg", 2) == {"imported": 1}
+        assert glob.drain(10.0)
+        glob.flush_once(timestamp=2)       # C2 — torn below
+    finally:
+        glob._stop.set()
+        glob.http_api.stop()
+        kill_journal_lock(glob._dedupe_journal)
+        kill_journal_lock(glob._engine_journal)
+        for s in glob._sockets + glob._listen_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+    # tear C2: drop the journal's FINAL frame (the group's COMMIT),
+    # exactly what a kill between the group's appends leaves behind
+    path = os.path.join(str(tmp_path), "engine.journal")
+    blob = open(path, "rb").read()
+    recs, _end, torn = decode_frames(blob, HEADER_BYTES)
+    assert not torn and recs[-1][0] == drec.REC_ENGINE_COMMIT
+    with open(path, "wb") as f:
+        f.write(blob[:HEADER_BYTES])
+        for rec_type, payload in recs[:-1]:
+            f.write(encode_frame(rec_type, payload))
+    glob2 = _mk_durable_global(tmp_path)
+    try:
+        # op 2 (above C1's watermark) replayed on top of C1's state...
+        assert glob2._recovery["ops_replayed"] >= 1
+        # ...its envelope still dedupes the sender's replay...
+        assert _post_import(glob2.http_api.port, body, "tg", 2) == \
+            {"imported": 0, "deduped": True}
+        assert glob2.drain(10.0)
+        out = {m.name: m.value
+               for m in glob2.flush_once(timestamp=9)}
+        # ...and its value is flushed once — not lost (the pre-fix
+        # failure mode: partial restore suppressed the replay), not
+        # doubled
+        assert out.get("tg.c") == 5.0
+    finally:
+        glob2.stop()

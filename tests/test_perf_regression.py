@@ -266,3 +266,67 @@ def test_empty_flush_cpu_cost_does_not_grow():
     eng.flush(timestamp=2)
     dt = time.process_time() - t0
     assert dt < 2.0, f"empty flush @10k slots used {dt:.2f}s CPU (gate 2.0)"
+
+
+def test_engine_checkpoint_steady_state_under_10pct_of_tick():
+    """ISSUE 9 gate (BENCH_SUITE_r10 c16's tier-1 twin): the flush-
+    boundary engine checkpoint must cost < 10% of the flush tick at
+    the ~1.6k-sketch c12 shape. The checkpoint runs AFTER the swap, so
+    its steady-state work is the delta encoding's degenerate case —
+    zero dirty piles, just the interner tables + staged scan — and the
+    cost is measured directly (checkpoint_state + record encode)
+    against the measured tick, not as a wall A/B. The default
+    (untracked) engine is also pinned as a structural no-op: no
+    bitmaps exist, so the landing-site guards are one attribute load
+    per BATCH."""
+    from veneur_tpu.durability import records as drec
+
+    # default engine: dirty tracking off is the no-op baseline
+    assert AggregationEngine(EngineConfig())._dirty is None
+
+    cfg = EngineConfig(histogram_slots=1024, counter_slots=2048,
+                       gauge_slots=512, set_slots=256,
+                       batch_size=2048, buffer_depth=256,
+                       percentiles=(0.5, 0.99),
+                       aggregates=("min", "max", "count"),
+                       is_global=True)
+    eng = AggregationEngine(cfg)
+    eng.enable_dirty_tracking()
+    rng = np.random.default_rng(0)
+
+    def feed():
+        for k in range(256):
+            means = np.sort(rng.normal(100, 9, 8).astype(np.float32))
+            w = np.ones(8, np.float32)
+            eng.import_histogram(MetricKey(f"p.h{k}", "timer", ""),
+                                 means, w, float(means.min()),
+                                 float(means.max()),
+                                 float(means.sum()), 8.0, 0.1)
+        for k in range(1024):
+            eng.import_counter(MetricKey(f"p.c{k}", "counter", ""), 1.0)
+        for k in range(256):
+            eng.import_gauge(MetricKey(f"p.g{k}", "gauge", ""), 2.0)
+        for k in range(64):
+            eng.import_set(MetricKey(f"p.s{k}", "set", ""),
+                           rng.integers(0, 30, 1 << 14)
+                           .astype(np.uint8))
+
+    feed()
+    eng.flush(timestamp=1)               # warm every executable
+    tick_s, ckpt_s = [], []
+    for i in range(3):
+        feed()
+        t0 = time.process_time()
+        eng.flush(timestamp=2 + i)
+        tick_s.append(time.process_time() - t0)
+        t0 = time.process_time()
+        snap = eng.checkpoint_state()
+        drec.encode_engine_checkpoint(0, 1, snap)
+        ckpt_s.append(time.process_time() - t0)
+        # post-swap steady state: the delta has nothing to serialize
+        assert snap["piles_dirty"] == 0
+    tick = sorted(tick_s)[1]
+    ckpt = sorted(ckpt_s)[1]
+    assert ckpt < 0.10 * tick, (
+        f"steady-state checkpoint {ckpt * 1e3:.2f}ms is "
+        f"{ckpt / tick:.1%} of the {tick * 1e3:.1f}ms tick")

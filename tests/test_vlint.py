@@ -86,6 +86,26 @@ def test_dr01_out_of_scope_modules_unchecked():
     assert [v for v in run_paths([path]) if v.rule == "DR01"] == []
 
 
+def test_dr02_bank_leaf_bytes_outside_records():
+    # .tobytes() on a leaf and np.frombuffer — exact lines; the
+    # suppressed wire row and plain bytes() must stay silent
+    assert lint("dr02_bad.py") == [("DR02", 9), ("DR02", 13)]
+
+
+def test_dr02_allows_the_records_module_itself():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "durability", "records.py")
+    assert [v for v in run_paths([path]) if v.rule == "DR02"] == []
+
+
+def test_dr02_out_of_scope_modules_unchecked():
+    # byte moves OUTSIDE the engine-state scope (e.g. the native
+    # bridge's poll-buffer marshalling) are not DR02's business
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "ingest", "native.py")
+    assert [v for v in run_paths([path]) if v.rule == "DR02"] == []
+
+
 def test_sr02_tdigest_bank_writes_outside_owner():
     # the construction (line 9), the _replace(weight=...) (line 20) and
     # the statically-opaque **kwargs forms (lines 34/38) are flagged;

@@ -51,6 +51,24 @@ DEFAULT_CONFIG = {
     "dr01_allow": (
         "veneur_tpu/durability/journal.py",
     ),
+    # DR02: engine-state serialization discipline — raw bank-leaf
+    # byte moves (`.tobytes()` / `np.frombuffer`) are single-homed in
+    # durability/records.py (path substring match; /dr02_ scopes the
+    # check's own fixture in). A stray tobytes/frombuffer in the
+    # engine/ops/cluster layers could re-encode bank rows outside the
+    # bit-exact record codecs the kill-restart identity depends on.
+    # Intentional non-bank byte moves (the HLL wire row, the CRC lane
+    # fold) suppress with a reason.
+    "dr02_scope": (
+        "veneur_tpu/durability/",
+        "veneur_tpu/models/",
+        "veneur_tpu/ops/",
+        "veneur_tpu/cluster/",
+        "/dr02_",
+    ),
+    "dr02_allow": (
+        "veneur_tpu/durability/records.py",
+    ),
     # OV01: counted-degradation discipline for the overload-defense
     # layer (path substring match; /ov01_ scopes the check's own
     # fixture in): a drop verdict (`return None`) in an admit*/fold*/

@@ -1,5 +1,5 @@
 """Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02, DR01,
-TL01, OV01.
+DR02, TL01, OV01.
 
 All checks are intentionally conservative: they resolve only what can
 be resolved statically within the project (local jit wrappers, module
@@ -824,6 +824,50 @@ def check_dr01(mod: PyModule, config: dict) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------------------- DR02
+
+def check_dr02(mod: PyModule, config: dict) -> list[Violation]:
+    """Engine-state serialization discipline (the ISSUE 9 counterpart
+    of DR01's write discipline): within the engine/ops/cluster/
+    durability layers, raw numpy byte moves — `<arr>.tobytes()` and
+    `np.frombuffer(...)` — are single-homed in durability/records.py,
+    whose codecs are the ONLY place bank leaves may become bytes. A
+    stray tobytes/frombuffer elsewhere could serialize bank rows
+    through a lossy path (float formatting, zero-weight dropping,
+    re-ordering) and silently break the kill-restart bit-identity the
+    engine checkpoint guarantees. Legitimate non-bank byte moves (the
+    HLL wire row in cluster/wire.py, the CRC lane fold in journal.py)
+    suppress with a documented reason."""
+    if not any(m in mod.path for m in config["dr02_scope"]):
+        return []
+    if any(mod.path.endswith(a) for a in config["dr02_allow"]):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        leaf = (d.rsplit(".", 1)[-1] if d is not None
+                else getattr(node.func, "attr", None))
+        if leaf == "tobytes" and isinstance(node.func, ast.Attribute):
+            out.append(Violation(
+                mod.path, node.lineno, "DR02",
+                ".tobytes() outside durability/records.py — engine-"
+                "state byte codecs are single-homed there (bit-exact "
+                "leaf framing); route the array through a records.py "
+                "codec or suppress with a reason naming what non-bank "
+                "bytes these are"))
+        elif leaf == "frombuffer" and isinstance(node.func,
+                                                ast.Attribute):
+            out.append(Violation(
+                mod.path, node.lineno, "DR02",
+                "frombuffer() outside durability/records.py — engine-"
+                "state byte codecs are single-homed there; decode "
+                "through a records.py codec or suppress with a reason "
+                "naming what non-bank bytes these are"))
+    return out
+
+
 # ------------------------------------------------------------------- TL01
 
 _TL01_PREFIX = "veneur."
@@ -1009,6 +1053,7 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_rs01(mod, config))
     out.extend(check_sr02(mod, config))
     out.extend(check_dr01(mod, config))
+    out.extend(check_dr02(mod, config))
     out.extend(check_tl01(mod, config))
     out.extend(check_tr01(mod, config))
     out.extend(check_ov01(mod, config))

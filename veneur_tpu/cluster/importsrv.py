@@ -46,6 +46,23 @@ class ImportedMetric:
         self.pb = pb
 
 
+class ImportedBatch:
+    """Worker-queue envelope for one journaled import op's share of
+    metrics for ONE engine (durability/ ISSUE 9): the worker applies
+    the group atomically (engine.import_list) and the op id advances
+    that engine's applied-op watermark — the consistent cut the
+    engine checkpoint's replay filter depends on. Only the durable
+    submit path (Server._submit_import_batch) produces these; the
+    per-metric ImportedMetric path is unchanged when the engine
+    journal is off."""
+
+    __slots__ = ("op_id", "pbs")
+
+    def __init__(self, op_id, pbs):
+        self.op_id = op_id
+        self.pbs = pbs
+
+
 class _SenderState:
     __slots__ = ("watermark", "seqs", "last_seen")
 
@@ -238,7 +255,7 @@ class ForwardHandler(grpc.GenericRpcHandler):
 
     def __init__(self, submit, ledger: DedupeLedger | None = None,
                  registry: ResilienceRegistry | None = None,
-                 observer=None):
+                 observer=None, submit_batch=None):
         """`submit(worker_index_hash, ImportedMetric)` routes one metric;
         the Server provides a queue-backed implementation. `ledger`
         (optional) dedupes envelope-bearing requests. `observer`
@@ -246,8 +263,14 @@ class ForwardHandler(grpc.GenericRpcHandler):
         dedupe/apply phases in the import ring, replays them as SSF
         spans parented on the remote sender's flush span, and feeds
         the per-sender fleet view — observability only, it never
-        changes what is admitted or applied."""
+        changes what is admitted or applied. `submit_batch` (optional,
+        `submit_batch([(digest, pb), ...])`) routes one request's
+        metrics as a unit — the durable path: the Server's
+        implementation write-aheads the batch to the engine journal
+        BEFORE any worker queue sees it, so an admitted-and-acked
+        interval survives a receiver crash."""
         self._submit = submit
+        self._submit_batch = submit_batch
         self._ledger = ledger
         self._registry = registry or DEFAULT_REGISTRY
         self._observer = observer
@@ -281,6 +304,32 @@ class ForwardHandler(grpc.GenericRpcHandler):
             return
         self._submit(digest, ImportedMetric(m))
 
+    def _route_all(self, metrics, env=None) -> int:
+        """Digest + route one request's metrics: a single batch-submit
+        call when the server provided one (the write-ahead journal
+        must see the request as ONE op — with its admitted envelope —
+        before any queue does), else the legacy per-metric submit.
+        Returns the routed count."""
+        if self._submit_batch is None:
+            n = 0
+            for m in metrics:
+                self._route(m)
+                n += 1
+            return n
+        pairs = []
+        for m in metrics:
+            try:
+                key = wire.metric_key_of(m)
+                digest = metric_digest(key.name, key.type,
+                                       key.joined_tags)
+            except Exception as e:
+                self._registry.incr("import", "import.rejected")
+                log.warning("rejected unroutable imported metric: %s", e)
+                continue
+            pairs.append((digest, m))
+        self._submit_batch(pairs, env)
+        return len(pairs)
+
     def _admit(self, env) -> bool:
         if env is None or self._ledger is None:
             return True
@@ -295,10 +344,7 @@ class ForwardHandler(grpc.GenericRpcHandler):
         if not ok:
             return
         ph = scope.start("apply")
-        n = 0
-        for m in metrics:
-            self._route(m)
-            n += 1
+        n = self._route_all(metrics, env)
         scope.finish(ph, n_metrics=n)
         scope.n_metrics = n
 
@@ -308,8 +354,7 @@ class ForwardHandler(grpc.GenericRpcHandler):
         obs = self._observer
         if obs is None:
             if self._admit(env):
-                for m in request.metrics:
-                    self._route(m)
+                self._route_all(request.metrics, env)
             return forward_pb2.Empty()
         with obs.request(env, trace, "grpc") as scope:
             self._apply(scope, env, request.metrics)
@@ -323,16 +368,12 @@ class ForwardHandler(grpc.GenericRpcHandler):
         obs = self._observer
         if env is None or self._ledger is None:
             if obs is None:
-                for m in request_iterator:
-                    self._route(m)
+                self._route_all(request_iterator)
                 return forward_pb2.Empty()
             with obs.request(env, trace, "grpc-stream") as scope:
                 scope.admitted = True
                 ph = scope.start("apply")
-                n = 0
-                for m in request_iterator:
-                    self._route(m)
-                    n += 1
+                n = self._route_all(request_iterator)
                 scope.finish(ph, n_metrics=n)
                 scope.n_metrics = n
             return forward_pb2.Empty()
@@ -346,8 +387,7 @@ class ForwardHandler(grpc.GenericRpcHandler):
         metrics = list(request_iterator)
         if obs is None:
             if self._ledger.admit(*env):
-                for m in metrics:
-                    self._route(m)
+                self._route_all(metrics, env)
             return forward_pb2.Empty()
         with obs.request(env, trace, "grpc-stream") as scope:
             self._apply(scope, env, metrics)
@@ -357,13 +397,13 @@ class ForwardHandler(grpc.GenericRpcHandler):
 def start_import_server(address: str, submit, max_workers: int = 8,
                         ledger: DedupeLedger | None = None,
                         registry: ResilienceRegistry | None = None,
-                        observer=None):
+                        observer=None, submit_batch=None):
     """Bind a gRPC server for the Forward service; returns (server, port)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
         (ForwardHandler(submit, ledger=ledger, registry=registry,
-                        observer=observer),))
+                        observer=observer, submit_batch=submit_batch),))
     port = server.add_insecure_port(address)
     server.start()
     log.info("importsrv listening on %s", address)

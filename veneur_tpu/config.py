@@ -127,6 +127,24 @@ class Config:
     # snapshot+compact a journal once it outgrows this many bytes
     # (checked at flush boundaries; atomic write-temp/fsync/rename)
     durability_snapshot_journal_bytes: int = 1 << 22
+    # Global-tier engine checkpointing (ISSUE 9): with durability on,
+    # an IMPORT-tier server (a gRPC import listener, or is_global —
+    # NOT http_address alone, which is also just the ops listener on
+    # sending tiers; an HTTP-only global sets is_global: true)
+    # additionally write-aheads every admitted import op and delta-
+    # checkpoints its engines' merged sketch state (dirty piles only,
+    # plus the interner key tables and staged imports) at each flush
+    # boundary — a hard-killed global restarts with the fleet's
+    # admitted-and-merged interval state, bit-identical at the next
+    # flush. No effect on sending-only servers, with mesh engines
+    # (sharded banks), or under native_ingest (the bridge owns the
+    # interner). Requires durability_enabled.
+    durability_engine_snapshot: bool = True
+    # dirty fraction above which a checkpoint fetches whole bank
+    # leaves and slices on host instead of a device-side row gather
+    # (a near-full gather costs more than the contiguous fetch);
+    # only the dirty rows are serialized either way. (0, 1].
+    durability_engine_delta_threshold: float = 0.5
 
     # --- overload defense (veneur_tpu/ingest/admission.py) ---
     # Off by default: with the defense disabled the ingest path does
@@ -358,6 +376,12 @@ def _validate(cfg: Config) -> None:
         raise ValueError(
             "durability_snapshot_journal_bytes must be >= 4096 "
             "(a snapshot cycle per append would thrash the disk)")
+    if not (0.0 < cfg.durability_engine_delta_threshold <= 1.0):
+        raise ValueError(
+            "durability_engine_delta_threshold must be in (0, 1]: it "
+            "is the dirty fraction above which a checkpoint switches "
+            "from row gather to whole-leaf fetch, got "
+            f"{cfg.durability_engine_delta_threshold!r}")
     for key in ("flush_timeout", "retry_backoff_base",
                 "retry_backoff_cap", "retry_deadline",
                 "breaker_open_duration", "forward_dedupe_ttl",

@@ -20,9 +20,13 @@ envelopes exist to close. This package persists exactly that state:
     HLL registers, counters, gauges), spill-tier contents with gauge
     ages, and receiver-side per-sender watermarks.
   * `state` — the integration façades: `ForwardJournal` (the sender's
-    op log, consumed by `resilience.ResilientForwarder`) and
+    op log, consumed by `resilience.ResilientForwarder`),
     `WatermarkJournal` (the receiver's per-flush watermark log,
-    consumed by `Server` + `cluster.importsrv.DedupeLedger`).
+    consumed by `Server` + `cluster.importsrv.DedupeLedger`), and
+    `EngineJournal` (the global tier's engine-state log: write-ahead
+    import ops + per-engine delta checkpoints at flush boundaries, so
+    the fleet's admitted-and-merged interval state survives a crash
+    and a restarted global flushes BIT-IDENTICAL state).
 
 Mergeable-sketch semantics are what make the recovered state safe: a
 parked interval's t-digest centroids / HLL registers / counter sums
@@ -36,6 +40,7 @@ snapshot API — vlint DR01 machine-checks that no other module under
 """
 
 from .journal import Journal, crc32c
-from .state import ForwardJournal, WatermarkJournal
+from .state import EngineJournal, ForwardJournal, WatermarkJournal
 
-__all__ = ["Journal", "crc32c", "ForwardJournal", "WatermarkJournal"]
+__all__ = ["Journal", "crc32c", "EngineJournal", "ForwardJournal",
+           "WatermarkJournal"]

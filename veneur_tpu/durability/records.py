@@ -34,11 +34,55 @@ Receiver-side "dedupe" journal:
                  boundary — a restarted global restores these so an
                  ancient replay (already flushed downstream before the
                  crash) is dropped, not re-admitted
+
+Receiver-side "engine" journal (ISSUE 9 — global-tier checkpoint):
+
+    ENGINE_IMPORT  write-ahead op log: one admitted import request's
+                   metrics as forwardrpc.MetricList bytes (the wire
+                   codec, reused verbatim) under a monotone op id,
+                   appended BEFORE the worker queues see the metrics
+                   and before the sender's ack — an admitted-and-acked
+                   interval can no longer die with the process
+    ENGINE_META    one engine's checkpoint header at a flush boundary:
+                   shape fingerprint (a restore against a differently-
+                   configured engine must refuse loudly, not scatter
+                   rows into the wrong slots), the applied-op
+                   watermark (ops <= it are inside the checkpoint; ops
+                   above it replay on top), and the gauge sequence
+    ENGINE_KEYS    one bank's full interner table (slot -> key/scope/
+                   last-interval) + the interner's interval counter
+    ENGINE_BANK    one bank's DIRTY rows: banks are interval-scoped
+                   (the flush swap re-zeroes every row), so "fresh
+                   init + the rows touched since the swap" IS the full
+                   bank state — a checkpoint is self-contained and a
+                   steady-state tick serializes only touched piles.
+                   Leaves ride as raw little-endian numpy bytes:
+                   recovery must hand back BIT-EXACT f32/u8 rows (the
+                   wire's centroid list drops zero-weight entries and
+                   re-orders — fine for forwarding, fatal for a
+                   restore that must flush bit-identically). This
+                   module is the ONLY home of bank-leaf tobytes()/
+                   frombuffer (vlint DR02).
+    ENGINE_STAGED  one engine's staged-but-unlanded import
+                   accumulators (centroid piles, HLL rows, exact-f64
+                   counter sums, last-write-wins gauges) — applied ops
+                   whose data has not reached the device yet live
+                   here, so the watermark stays honest
+    ENGINE_COMMIT  group-completeness marker, LAST record of each
+                   engine's checkpoint group. The group's records are
+                   separate journal frames, so a crash mid-append can
+                   leave META on disk without its KEYS/BANK/STAGED —
+                   recovery only accepts a group whose COMMIT arrived,
+                   falling back to the engine's previous complete
+                   group otherwise (a torn META whose watermark still
+                   suppressed op replay would be silent data loss)
 """
 
 from __future__ import annotations
 
 import struct
+
+import numpy as np
 
 from ..models.pipeline import ForwardExport
 
@@ -51,6 +95,31 @@ REC_DEMOTE = 6
 REC_SPILL_MERGE = 7
 REC_SPILL_STATE = 8
 REC_WATERMARKS = 9
+REC_ENGINE_IMPORT = 10
+REC_ENGINE_META = 11
+REC_ENGINE_KEYS = 12
+REC_ENGINE_BANK = 13
+REC_ENGINE_STAGED = 14
+REC_ENGINE_COMMIT = 15
+
+# engine bank kinds (the order pipeline.AggregationEngine owns them in)
+BANK_HISTO = 0
+BANK_COUNTER = 1
+BANK_GAUGE = 2
+BANK_SET = 3
+
+# leaf order per bank kind — load-bearing: encode and decode walk the
+# same tuple, and a new leaf added to a bank NamedTuple must be added
+# here (the fingerprint's shape fields catch width drift, this catches
+# leaf drift)
+HISTO_LEAVES = ("mean", "weight", "buf_value", "buf_weight", "buf_n",
+                "vmin", "vmax", "vsum", "count", "recip", "vsum_lo",
+                "count_lo", "recip_lo")
+COUNTER_LEAVES = ("hi", "lo")
+GAUGE_LEAVES = ("value", "seq")
+SET_LEAVES = ("registers",)
+BANK_LEAVES = {BANK_HISTO: HISTO_LEAVES, BANK_COUNTER: COUNTER_LEAVES,
+               BANK_GAUGE: GAUGE_LEAVES, BANK_SET: SET_LEAVES}
 
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
@@ -226,3 +295,263 @@ def decode_watermarks(data: bytes) -> dict:
         off += _U64.size
         marks[sender_id] = seq
     return marks
+
+
+# ---------------------------------------- engine checkpoint (global tier)
+#
+# The serialization home for engine state (vlint DR02): bank leaves
+# cross into and out of the journal ONLY here, as raw little-endian
+# numpy buffers — bit-exact by construction, no float formatting, no
+# zero-weight dropping, no re-ordering.
+
+_ENG_META = struct.Struct("<IIQQ")      # engine_idx, n_engines, watermark,
+                                        # gauge_seq
+_ENG_FPR = struct.Struct("<IIIIIIId")   # histo K, C, B, counter K, gauge K,
+                                        # set K, hll m, compression
+_ENG_KEYS_HEAD = struct.Struct("<IBII")  # engine_idx, bank_kind, interval, n
+_ENG_KEY_ENTRY = struct.Struct("<IiI")   # slot, scope, last_interval
+_ENG_BANK_HEAD = struct.Struct("<IBI")   # engine_idx, bank_kind, n_rows
+_ENG_LEAF_HEAD = struct.Struct("<BI")    # dtype code, row width (0 = 1-D)
+
+_DTYPE_CODES = {0: np.float32, 1: np.int32, 2: np.uint8, 3: np.int64}
+_CODE_OF_DTYPE = {np.dtype(v): k for k, v in _DTYPE_CODES.items()}
+
+
+def engine_fingerprint(cfg, num_centroids: int) -> tuple:
+    """The shape identity a checkpoint was taken under. A restore into
+    an engine with a different fingerprint must refuse whole (rows would
+    scatter into the wrong slots / wrong widths)."""
+    return (int(cfg.histogram_slots), int(num_centroids),
+            int(cfg.buffer_depth), int(cfg.counter_slots),
+            int(cfg.gauge_slots), int(cfg.set_slots),
+            1 << int(cfg.hll_precision), float(cfg.compression))
+
+
+def encode_engine_import(op_id: int, metrics, envelope=None) -> bytes:
+    """One admitted import request: its metricpb.Metric list as
+    forwardrpc.MetricList bytes (the forward wire codec, reused) under
+    a monotone op id, plus the request's idempotency envelope
+    (sender_id, interval_seq, chunk_index, chunk_count) when it
+    carried one. The envelope is LOAD-BEARING for recovery: restoring
+    admitted-and-merged state without also restoring the dedupe
+    ledger's memory of its envelope would let the sender's ambiguous-
+    failure replay of that same interval re-admit and DOUBLE-COUNT —
+    the exact bug the one-tick-behind watermark journal was allowed to
+    tolerate only while admitted state died with the process.
+    Protobuf roundtrips its f32/f64 fields exactly, so replaying the
+    decoded metrics is bit-identical to applying the originals."""
+    from ..cluster.protos import forward_pb2
+    blob = forward_pb2.MetricList(metrics=list(metrics)) \
+        .SerializeToString()
+    head = _U64.pack(op_id)
+    if envelope is None:
+        return head + b"\x00" + blob
+    sender_id, seq, chunk_index, chunk_count = envelope
+    return (head + b"\x01" + _pack_str(str(sender_id))
+            + _U64.pack(int(seq))
+            + _U32.pack(int(chunk_index)) + _U32.pack(int(chunk_count))
+            + blob)
+
+
+def decode_engine_import(data: bytes):
+    """-> (op_id, [metricpb.Metric], envelope tuple or None)."""
+    from ..cluster.protos import forward_pb2
+    (op_id,) = _U64.unpack_from(data, 0)
+    off = _U64.size
+    has_env = data[off]
+    off += 1
+    envelope = None
+    if has_env:
+        sender_id, off = _unpack_str(data, off)
+        (seq,) = _U64.unpack_from(data, off)
+        off += _U64.size
+        chunk_index, chunk_count = struct.unpack_from("<II", data, off)
+        off += 8
+        envelope = (sender_id, seq, chunk_index, chunk_count)
+    ml = forward_pb2.MetricList.FromString(data[off:])
+    return op_id, list(ml.metrics), envelope
+
+
+def encode_engine_meta(engine_idx: int, n_engines: int, watermark: int,
+                       gauge_seq: int, fingerprint: tuple) -> bytes:
+    return _ENG_META.pack(engine_idx, n_engines, watermark,
+                          int(gauge_seq)) + _ENG_FPR.pack(*fingerprint)
+
+
+def decode_engine_meta(data: bytes):
+    engine_idx, n_engines, watermark, gauge_seq = \
+        _ENG_META.unpack_from(data, 0)
+    fpr = _ENG_FPR.unpack_from(data, _ENG_META.size)
+    return engine_idx, n_engines, watermark, gauge_seq, tuple(fpr)
+
+
+def encode_engine_keys(engine_idx: int, bank_kind: int, interval: int,
+                       entries) -> bytes:
+    """One bank's interner table: [(slot, scope, last_interval, name,
+    type, joined_tags)] + the interner's interval counter."""
+    entries = list(entries)
+    out = [_ENG_KEYS_HEAD.pack(engine_idx, bank_kind, interval,
+                               len(entries))]
+    for slot, scope, last_interval, name, mtype, tags in entries:
+        out.append(_ENG_KEY_ENTRY.pack(slot, scope, last_interval))
+        out.append(_pack_str(name))
+        out.append(_pack_str(mtype))
+        out.append(_pack_str(tags))
+    return b"".join(out)
+
+
+def decode_engine_keys(data: bytes):
+    engine_idx, bank_kind, interval, n = _ENG_KEYS_HEAD.unpack_from(data, 0)
+    off = _ENG_KEYS_HEAD.size
+    entries = []
+    for _ in range(n):
+        slot, scope, last_interval = _ENG_KEY_ENTRY.unpack_from(data, off)
+        off += _ENG_KEY_ENTRY.size
+        name, off = _unpack_str(data, off)
+        mtype, off = _unpack_str(data, off)
+        tags, off = _unpack_str(data, off)
+        entries.append((slot, scope, last_interval, name, mtype, tags))
+    return engine_idx, bank_kind, interval, entries
+
+
+def _encode_leaf(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    width = arr.shape[1] if arr.ndim == 2 else 0
+    return _ENG_LEAF_HEAD.pack(_CODE_OF_DTYPE[arr.dtype], width) \
+        + arr.tobytes()
+
+
+def _decode_leaf(data: bytes, off: int, n_rows: int):
+    code, width = _ENG_LEAF_HEAD.unpack_from(data, off)
+    off += _ENG_LEAF_HEAD.size
+    dtype = np.dtype(_DTYPE_CODES[code])
+    count = n_rows * (width or 1)
+    nbytes = count * dtype.itemsize
+    arr = np.frombuffer(data, dtype, count, off).copy()
+    if width:
+        arr = arr.reshape(n_rows, width)
+    return arr, off + nbytes
+
+
+def encode_engine_bank(engine_idx: int, bank_kind: int,
+                       slot_ids: np.ndarray, leaves: dict) -> bytes:
+    """One bank's dirty rows: slot ids + every leaf's rows at those
+    ids, in the fixed BANK_LEAVES order, as raw little-endian bytes."""
+    slot_ids = np.ascontiguousarray(slot_ids, np.int32)
+    out = [_ENG_BANK_HEAD.pack(engine_idx, bank_kind, len(slot_ids)),
+           slot_ids.tobytes()]
+    for name in BANK_LEAVES[bank_kind]:
+        out.append(_encode_leaf(leaves[name]))
+    return b"".join(out)
+
+
+def decode_engine_bank(data: bytes):
+    engine_idx, bank_kind, n = _ENG_BANK_HEAD.unpack_from(data, 0)
+    off = _ENG_BANK_HEAD.size
+    slot_ids = np.frombuffer(data, np.int32, n, off).copy()
+    off += n * 4
+    leaves = {}
+    for name in BANK_LEAVES[bank_kind]:
+        leaves[name], off = _decode_leaf(data, off, n)
+    return engine_idx, bank_kind, slot_ids, leaves
+
+
+def encode_engine_staged(engine_idx: int, staged: dict) -> bytes:
+    """Staged-but-unlanded import accumulators, order-preserving (the
+    landing order feeds the k1 clustering and the gauge sequence, both
+    order-sensitive):
+      centroids   [(slot, means f32[w], weights f32[w], min, max, sum,
+                    count, recip)]
+      sets        [(slot, registers u8[m])]
+      counters    [(slot, exact f64 sum)]   (dict insertion order)
+      gauges      [(slot, f64 value)]       (dict insertion order)
+    """
+    out = [_U32.pack(engine_idx)]
+    cents = staged.get("centroids", [])
+    out.append(_U32.pack(len(cents)))
+    for slot, means, weights, vmin, vmax, vsum, cnt, recip in cents:
+        means = np.ascontiguousarray(means, np.float32)
+        weights = np.ascontiguousarray(weights, np.float32)
+        out.append(_U32.pack(int(slot)) + _U32.pack(len(means)))
+        out.append(means.tobytes())
+        out.append(weights.tobytes())
+        out.append(struct.pack("<5d", vmin, vmax, vsum, cnt, recip))
+    sets = staged.get("sets", [])
+    out.append(_U32.pack(len(sets)))
+    for slot, regs in sets:
+        regs = np.ascontiguousarray(regs, np.uint8)
+        out.append(_U32.pack(int(slot)) + _U32.pack(len(regs)))
+        out.append(regs.tobytes())
+    for field in ("counters", "gauges"):
+        items = staged.get(field, [])
+        out.append(_U32.pack(len(items)))
+        for slot, value in items:
+            out.append(_U32.pack(int(slot)) + _F64.pack(float(value)))
+    return b"".join(out)
+
+
+def decode_engine_staged(data: bytes):
+    (engine_idx,) = _U32.unpack_from(data, 0)
+    off = _U32.size
+    staged = {"centroids": [], "sets": [], "counters": [], "gauges": []}
+    (n,) = _U32.unpack_from(data, off)
+    off += _U32.size
+    for _ in range(n):
+        slot, w = struct.unpack_from("<II", data, off)
+        off += 8
+        means = np.frombuffer(data, np.float32, w, off).copy()
+        off += 4 * w
+        weights = np.frombuffer(data, np.float32, w, off).copy()
+        off += 4 * w
+        scalars = struct.unpack_from("<5d", data, off)
+        off += 40
+        staged["centroids"].append((slot, means, weights) + scalars)
+    (n,) = _U32.unpack_from(data, off)
+    off += _U32.size
+    for _ in range(n):
+        slot, m = struct.unpack_from("<II", data, off)
+        off += 8
+        regs = np.frombuffer(data, np.uint8, m, off).copy()
+        off += m
+        staged["sets"].append((slot, regs))
+    for field in ("counters", "gauges"):
+        (n,) = _U32.unpack_from(data, off)
+        off += _U32.size
+        for _ in range(n):
+            (slot,) = _U32.unpack_from(data, off)
+            off += _U32.size
+            (value,) = _F64.unpack_from(data, off)
+            off += _F64.size
+            staged[field].append((slot, value))
+    return engine_idx, staged
+
+
+def encode_engine_checkpoint(engine_idx: int, n_engines: int,
+                             snap: dict) -> list:
+    """One engine's flush-boundary checkpoint as a typed-record list
+    (the unit the server appends per tick and hands to snapshot
+    compaction — self-contained: fresh banks + these records IS the
+    engine's state at the boundary)."""
+    recs = [(REC_ENGINE_META, encode_engine_meta(
+        engine_idx, n_engines, snap["last_import_op"],
+        snap["gauge_seq"], snap["fingerprint"]))]
+    for kind, (interval, entries) in snap["interner"].items():
+        recs.append((REC_ENGINE_KEYS, encode_engine_keys(
+            engine_idx, kind, interval, entries)))
+    for kind, (slot_ids, leaves) in snap["banks"].items():
+        if len(slot_ids) == 0:
+            continue              # fresh rows need no record
+        recs.append((REC_ENGINE_BANK, encode_engine_bank(
+            engine_idx, kind, slot_ids, leaves)))
+    staged = snap["staged"]
+    if any(staged.get(f) for f in ("centroids", "sets", "counters",
+                                   "gauges")):
+        recs.append((REC_ENGINE_STAGED,
+                     encode_engine_staged(engine_idx, staged)))
+    # completeness marker LAST: recovery only trusts committed groups
+    recs.append((REC_ENGINE_COMMIT, _U32.pack(engine_idx)))
+    return recs
+
+
+def decode_engine_commit(data: bytes) -> int:
+    return _U32.unpack_from(data, 0)[0]

@@ -103,6 +103,79 @@ class ForwardJournal:
         self.journal.close()
 
 
+class EngineJournal:
+    """The global tier's engine-state log (ISSUE 9).
+
+    Two record families share one journal+snapshot pair named
+    "engine": ENGINE_IMPORT write-ahead ops appended by the import
+    handler threads (BEFORE the sender's ack — an admitted interval
+    survives a crash), and per-engine checkpoint groups (META + KEYS +
+    BANK + STAGED) appended by the flusher at each flush boundary.
+    A checkpoint group is SELF-CONTAINED (banks are interval-scoped:
+    fresh init + dirty rows is the whole state), so compaction writes
+    the latest groups plus the ops the per-engine watermarks haven't
+    covered yet, and truncates everything older.
+
+    Storage only — the application semantics (which ops replay, how
+    banks rebuild) live with the engine in models/pipeline.py and the
+    Server's recovery pass."""
+
+    def __init__(self, directory: str, fsync: str = "interval",
+                 fsync_interval_s: float = 1.0,
+                 snapshot_journal_bytes: int = 1 << 22,
+                 clock=time.monotonic, registry=None,
+                 destination: str = "durability"):
+        self.journal = Journal(directory, "engine", fsync=fsync,
+                               fsync_interval_s=fsync_interval_s,
+                               clock=clock, registry=registry,
+                               destination=destination)
+        self.snapshot_journal_bytes = snapshot_journal_bytes
+        self.last_checkpoint_bytes = 0
+
+    def load_records(self) -> list:
+        """All recoverable records in write order (snapshot groups
+        first, then the journal's). Truncates any torn tail; never
+        raises."""
+        snapshot, journal = self.journal.load()
+        return list(snapshot or []) + list(journal)
+
+    def append_import(self, payload: bytes):
+        """Write-ahead one admitted import op (already encoded by
+        records.encode_engine_import). Called from handler threads;
+        the journal's lock serializes against checkpoint appends."""
+        self.journal.append(records.REC_ENGINE_IMPORT, payload)
+
+    def append_checkpoint(self, recs) -> int:
+        """Append one flush boundary's checkpoint record groups;
+        returns the bytes written (the engine_snapshot_bytes gauge)."""
+        n = 0
+        for rec_type, payload in recs:
+            n += self.journal.append(rec_type, payload)
+        self.last_checkpoint_bytes = n
+        return n
+
+    def maybe_compact(self, snapshot_records) -> bool:
+        """Snapshot + truncate when the journal outgrew its budget.
+        `snapshot_records` is the full-state record list (latest
+        checkpoint groups + retained uncovered ops)."""
+        if self.journal.size_bytes() < self.snapshot_journal_bytes:
+            return False
+        self.journal.snapshot(snapshot_records)
+        return True
+
+    def sync(self):
+        self.journal.sync()
+
+    def size_bytes(self) -> int:
+        return self.journal.size_bytes()
+
+    def generation(self) -> int:
+        return self.journal._generation
+
+    def close(self):
+        self.journal.close()
+
+
 class WatermarkJournal:
     """The receiver-side watermark log. Appends happen on the flusher
     thread (flush boundary); recovery runs in Server.__init__, before

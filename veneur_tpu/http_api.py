@@ -78,7 +78,8 @@ class HttpApi:
 
     def __init__(self, address: str, submit=None, healthy=None,
                  ledger=None, debug_state=None, profile=None,
-                 observer=None, fleet_state=None, health=None):
+                 observer=None, fleet_state=None, health=None,
+                 submit_batch=None):
         """`debug_state()` (optional) returns the JSON-ready dict for
         GET /debug/flush; `profile(ticks)` (optional) schedules an
         on-demand jax.profiler capture — absent means the knob is off
@@ -94,10 +95,17 @@ class HttpApi:
         per-check breakdown — unhealthy answers 503, so a wedged
         flusher is detectable from OUTSIDE the process, not only by
         absence of data. Without `health`, /healthz degrades to the
-        legacy boolean `healthy` callback."""
+        legacy boolean `healthy` callback.
+
+        `submit_batch` (optional, `submit_batch([(digest, pb), ...])`)
+        routes one request's decoded metrics as a unit — the Server's
+        durable implementation write-aheads the batch to the engine
+        journal before any worker queue (and therefore before the 200
+        ack) sees it."""
         host, _, port = address.rpartition(":")
         host = host.strip("[]") or "0.0.0.0"
         self._submit = submit
+        self._submit_batch = submit_batch
         self._healthy = healthy or (lambda: True)
         self._ledger = ledger   # cluster.importsrv.DedupeLedger or None
         self._debug_state = debug_state
@@ -276,10 +284,14 @@ class HttpApi:
                         "application/json")
                     return
                 ph = -1 if scope is None else scope.start("apply")
-                count = 0
-                for digest, pb in decoded:
-                    api._submit(digest, pb)
-                    count += 1
+                if api._submit_batch is not None:
+                    api._submit_batch(decoded, env)
+                    count = len(decoded)
+                else:
+                    count = 0
+                    for digest, pb in decoded:
+                        api._submit(digest, pb)
+                        count += 1
                 if scope is not None:
                     scope.finish(ph, n_metrics=count)
                     scope.n_metrics = count

@@ -635,6 +635,18 @@ class AggregationEngine:
         self._pres_bound = 4 * (cfg.histogram_slots + cfg.counter_slots
                                 + cfg.gauge_slots + cfg.set_slots)
         self.samples_processed = 0
+        # Engine checkpointing (durability/ ISSUE 9): dirty-slot
+        # bitmaps per bank, armed by enable_dirty_tracking (the Server
+        # does it when durability_engine_snapshot is on). None = zero
+        # tracking work — the regression-pinned default. Marked at
+        # every DEVICE LANDING site (scatter/merge dispatch), reset at
+        # the flush swap, so at any instant `fresh init + dirty rows`
+        # is exactly the bank state — what makes a flush-boundary
+        # delta checkpoint self-contained. last_import_op is the
+        # applied-op watermark recovery filters the replay log by.
+        self._dirty = None
+        self._delta_threshold = 0.5
+        self.last_import_op = 0
         # Overload defense (ingest/admission.py): attached by the
         # Server via attach_admission; None = every key mints freely
         # (direct engine construction, the pre-defense behavior).
@@ -835,6 +847,8 @@ class AggregationEngine:
         B = self.histo_bank.buf_size
         valid = slots >= 0
         vs = slots[valid]
+        if self._dirty is not None and vs.size:
+            self._dirty[0][vs] = True
         # Hot-slot detection, cheapest-first (this runs on EVERY pump
         # batch): a batch with <= B valid rows cannot overfill any slot,
         # so skip counting entirely. Otherwise bincount — one O(n + max)
@@ -911,6 +925,8 @@ class AggregationEngine:
     def ingest_counter_batch(self, slots, values, weights, count=None,
                              mark=None):
         def apply(n):
+            if self._dirty is not None:
+                self._mark_dirty(1, slots)
             self.counter_bank = self._kern["counter"](
                 self.counter_bank, slots, values, weights)
         self._ingest_batch(slots, count, mark, apply)
@@ -922,6 +938,8 @@ class AggregationEngine:
         # pre-flush sample can never outrank a newer post-flush one and
         # the counter cannot wrap within an interval.
         def apply(n):
+            if self._dirty is not None:
+                self._mark_dirty(2, slots)
             seqs = np.arange(1, len(slots) + 1, dtype=np.int32) \
                 + self._gauge_seq
             self._gauge_seq += n
@@ -931,6 +949,8 @@ class AggregationEngine:
 
     def ingest_set_batch(self, slots, reg_idx, rho, count=None, mark=None):
         def apply(n):
+            if self._dirty is not None:
+                self._mark_dirty(3, slots)
             self.set_bank = self._kern["set"](
                 self.set_bank, slots, reg_idx, rho)
         self._ingest_batch(slots, count, mark, apply)
@@ -952,16 +972,22 @@ class AggregationEngine:
 
     def _dispatch_counters(self):
         a = self._counter_stage.drain()
+        if self._dirty is not None:
+            self._mark_dirty(1, a["slots"])
         self.counter_bank = self._kern["counter"](
             self.counter_bank, a["slots"], a["values"], a["weights"])
 
     def _dispatch_gauges(self):
         a = self._gauge_stage.drain()
+        if self._dirty is not None:
+            self._mark_dirty(2, a["slots"])
         self.gauge_bank = self._kern["gauge"](
             self.gauge_bank, a["slots"], a["values"], a["seqs"])
 
     def _dispatch_sets(self):
         a = self._set_stage.drain()
+        if self._dirty is not None:
+            self._mark_dirty(3, a["slots"])
         self.set_bank = self._kern["set"](
             self.set_bank, a["slots"], a["reg_idx"], a["rho"])
 
@@ -1034,78 +1060,123 @@ class AggregationEngine:
         """Stage a forwarded digest for merging — Histo.Combine
         (importsrv path, worker.go sym: Worker.ImportMetricGRPC)."""
         with self.lock:
-            slot = self.histo_keys.lookup(key, GLOBAL_ONLY)
-            if slot == FOLD_SLOT:
-                slot = self._fold_import_slot(self.histo_keys, key)
-            if slot < 0:
-                return
-            means = np.asarray(means, np.float32)
-            self._import_centroids.append(
-                (slot, means, np.asarray(weights, np.float32),
-                 float(vmin), float(vmax), float(vsum), float(count),
-                 float(recip)))
-            self._import_centroid_total += len(means)
-            if (len(self._import_centroids) >= _IMPORT_STAGE_DIGESTS
-                    or self._import_centroid_total
-                    >= _IMPORT_STAGE_CENTROIDS):
-                self._flush_import_centroids()
+            self._import_histogram_locked(key, means, weights, vmin,
+                                          vmax, vsum, count, recip)
+
+    def _import_histogram_locked(self, key, means, weights, vmin, vmax,
+                                 vsum, count, recip=0.0):
+        slot = self.histo_keys.lookup(key, GLOBAL_ONLY)
+        if slot == FOLD_SLOT:
+            slot = self._fold_import_slot(self.histo_keys, key)
+        if slot < 0:
+            return
+        means = np.asarray(means, np.float32)
+        self._import_centroids.append(
+            (slot, means, np.asarray(weights, np.float32),
+             float(vmin), float(vmax), float(vsum), float(count),
+             float(recip)))
+        self._import_centroid_total += len(means)
+        if (len(self._import_centroids) >= _IMPORT_STAGE_DIGESTS
+                or self._import_centroid_total
+                >= _IMPORT_STAGE_CENTROIDS):
+            self._flush_import_centroids()
 
     def import_set(self, key: MetricKey, registers):
         with self.lock:
-            slot = self.set_keys.lookup(key, GLOBAL_ONLY)
-            if slot == FOLD_SLOT:
-                slot = self._fold_import_slot(self.set_keys, key)
-            if slot < 0:
-                return
-            self._import_sets.append(
-                (slot, np.asarray(registers, np.uint8)))
-            if len(self._import_sets) >= 256:
-                self._flush_import_sets()
+            self._import_set_locked(key, registers)
+
+    def _import_set_locked(self, key, registers):
+        slot = self.set_keys.lookup(key, GLOBAL_ONLY)
+        if slot == FOLD_SLOT:
+            slot = self._fold_import_slot(self.set_keys, key)
+        if slot < 0:
+            return
+        self._import_sets.append(
+            (slot, np.asarray(registers, np.uint8)))
+        if len(self._import_sets) >= 256:
+            self._flush_import_sets()
 
     def import_counter(self, key: MetricKey, value: float):
         with self.lock:
-            slot = self.counter_keys.lookup(key, GLOBAL_ONLY)
-            if slot == FOLD_SLOT:
-                slot = self._fold_import_slot(self.counter_keys, key)
-            if slot < 0:
-                return
-            # Host-side f64 accumulation — exact, one device call per flush.
-            self._import_counter_acc[slot] = (
-                self._import_counter_acc.get(slot, 0.0) + float(value))
+            self._import_counter_locked(key, value)
+
+    def _import_counter_locked(self, key, value):
+        slot = self.counter_keys.lookup(key, GLOBAL_ONLY)
+        if slot == FOLD_SLOT:
+            slot = self._fold_import_slot(self.counter_keys, key)
+        if slot < 0:
+            return
+        # Host-side f64 accumulation — exact, one device call per flush.
+        self._import_counter_acc[slot] = (
+            self._import_counter_acc.get(slot, 0.0) + float(value))
 
     def import_gauge(self, key: MetricKey, value: float):
         with self.lock:
-            slot = self.gauge_keys.lookup(key, GLOBAL_ONLY)
-            if slot == FOLD_SLOT:
-                slot = self._fold_import_slot(self.gauge_keys, key)
-            if slot < 0:
-                return
-            self._import_gauge_acc[slot] = float(value)  # last write wins
+            self._import_gauge_locked(key, value)
+
+    def _import_gauge_locked(self, key, value):
+        slot = self.gauge_keys.lookup(key, GLOBAL_ONLY)
+        if slot == FOLD_SLOT:
+            slot = self._fold_import_slot(self.gauge_keys, key)
+        if slot < 0:
+            return
+        self._import_gauge_acc[slot] = float(value)  # last write wins
+
+    def import_list(self, op_id: int, pbs) -> tuple:
+        """Atomically apply one journaled import op's metrics for this
+        engine (durability/ ISSUE 9): the whole group lands under ONE
+        lock hold and the applied-op watermark advances in the same
+        critical section, so a concurrent checkpoint_state() sees
+        either none of the op or all of it — the exactness the
+        watermark's replay filter depends on. Returns
+        (rerouted, rejected): fold keys homed on other engines as
+        (ImportFoldReroute, pb) pairs the worker loop re-routes, and
+        per-metric poison pills as (pb, exception) pairs it counts —
+        one corrupt metric must reject itself, not the op."""
+        from ..cluster import wire
+        rerouted, rejected = [], []
+        with self.lock:
+            for pb in pbs:
+                try:
+                    wire.apply_metric_to_engine_locked(self, pb)
+                except ImportFoldReroute as fr:
+                    rerouted.append((fr, pb))
+                except Exception as e:
+                    rejected.append((pb, e))
+            if op_id > self.last_import_op:
+                self.last_import_op = op_id
+        return rerouted, rejected
 
     def _flush_import_sets(self):
         if not self._import_sets:
             return
         items, self._import_sets = self._import_sets, []
+        slots = np.array([s for s, _ in items], np.int32)
+        if self._dirty is not None:
+            self._mark_dirty(3, slots)
         self.set_bank = jax.device_put(hll.merge_rows(
-            self.set_bank,
-            np.array([s for s, _ in items], np.int32),
+            self.set_bank, slots,
             np.stack([r for _, r in items])), self._device)
 
     def _flush_import_scalars(self):
         if self._import_counter_acc:
             acc, self._import_counter_acc = self._import_counter_acc, {}
+            slots = np.fromiter(acc.keys(), np.int32, len(acc))
+            if self._dirty is not None:
+                self._mark_dirty(1, slots)
             self.counter_bank = jax.device_put(scalar.counter_merge(
-                self.counter_bank,
-                np.fromiter(acc.keys(), np.int32, len(acc)),
+                self.counter_bank, slots,
                 np.fromiter(acc.values(), np.float32, len(acc))),
                 self._device)
         if self._import_gauge_acc:
             acc, self._import_gauge_acc = self._import_gauge_acc, {}
+            slots = np.fromiter(acc.keys(), np.int32, len(acc))
+            if self._dirty is not None:
+                self._mark_dirty(2, slots)
             seqs = np.arange(len(acc), dtype=np.int32) + self._gauge_seq + 1
             self._gauge_seq += len(acc)
             self.gauge_bank = jax.device_put(scalar.gauge_set(
-                self.gauge_bank,
-                np.fromiter(acc.keys(), np.int32, len(acc)),
+                self.gauge_bank, slots,
                 np.fromiter(acc.values(), np.float32, len(acc)), seqs),
                 self._device)
 
@@ -1196,6 +1267,8 @@ class AggregationEngine:
             trusted.update(oversized)
 
         slot_ids = np.fromiter(by_slot.keys(), np.int32, len(by_slot))
+        if self._dirty is not None:
+            self._mark_dirty(0, slot_ids)
         widths = [sum(len(m) for m, _ in piles)
                   for piles in by_slot.values()]
         W = max(128, int(np.ceil(max(widths) / 128.0) * 128))
@@ -1252,6 +1325,11 @@ class AggregationEngine:
                 self.gauge_bank, self.set_bank)
         (self.histo_bank, self.counter_bank,
          self.gauge_bank, self.set_bank) = self._fresh_fn()
+        if self._dirty is not None:
+            # the swap re-zeroed every row: from here `fresh init +
+            # dirty rows` describes the new banks exactly
+            for d in self._dirty:
+                d[:] = False
         return snap
 
     def _flush_device(self, snap, phases=None) -> dict:
@@ -1541,3 +1619,162 @@ class AggregationEngine:
         with self.lock:
             evs, self._pending_events = self._pending_events, []
         return evs, []
+
+    # ------------- engine checkpoint/restore (durability, ISSUE 9) ----
+    # Serialization stays single-homed in durability/records.py (vlint
+    # DR02): these methods move numpy arrays, never raw bytes.
+
+    def _bank_table(self):
+        """(kind, bank attr name, interner) rows in the fixed record
+        order durability/records.py's BANK_* constants name."""
+        return ((0, "histo_bank", self.histo_keys),
+                (1, "counter_bank", self.counter_keys),
+                (2, "gauge_bank", self.gauge_keys),
+                (3, "set_bank", self.set_keys))
+
+    def enable_dirty_tracking(self, delta_threshold: float = 0.5):
+        """Arm per-bank dirty-slot bitmaps (the Server calls this when
+        durability_engine_snapshot is on; the ROADMAP's incremental-
+        compress perf item wants the same bitmap). `delta_threshold` is
+        the dirty fraction above which checkpoint_state fetches whole
+        leaves and slices on host instead of a device-side row gather
+        (a near-full gather costs more than the contiguous fetch)."""
+        with self.lock:
+            self._delta_threshold = float(delta_threshold)
+            self._dirty = [
+                np.zeros(getattr(self, attr).num_slots, bool)
+                for _kind, attr, _ki in self._bank_table()]
+
+    def _mark_dirty(self, kind: int, slots):
+        """Record device-landing touches. Call sites guard on
+        self._dirty so the untracked default costs one attribute
+        load."""
+        d = self._dirty[kind]
+        s = np.asarray(slots)
+        if s.size:
+            d[s[(s >= 0) & (s < d.size)]] = True
+
+    def checkpoint_state(self) -> dict:
+        """One engine's flush-boundary checkpoint, taken under the
+        ingest lock so it is a consistent cut: dirty bank rows (banks
+        are interval-scoped, so fresh init + these rows IS the state),
+        the full interner tables, the staged-but-unlanded import
+        accumulators, the gauge sequence, and the applied-op watermark
+        — everything restore_checkpoint needs, as numpy arrays (the
+        byte encoding lives in durability/records.py)."""
+        from ..durability import records as drecords
+        with self.lock:
+            banks: dict = {}
+            piles_total = piles_dirty = 0
+            for kind, attr, _ki in self._bank_table():
+                bank = getattr(self, attr)
+                d = self._dirty[kind]
+                ids = np.nonzero(d)[0].astype(np.int32)
+                piles_total += d.size
+                piles_dirty += ids.size
+                leaves: dict = {}
+                if ids.size:
+                    gather = ids.size < self._delta_threshold * d.size
+                    for name in drecords.BANK_LEAVES[kind]:
+                        leaf = getattr(bank, name)
+                        if gather:
+                            leaves[name] = np.asarray(
+                                jax.device_get(leaf[ids]))
+                        else:
+                            leaves[name] = np.asarray(leaf)[ids]
+                banks[kind] = (ids, leaves)
+            interner = {
+                kind: (ki.interval, ki.snapshot_entries())
+                for kind, _attr, ki in self._bank_table()}
+            staged = {
+                "centroids": list(self._import_centroids),
+                "sets": list(self._import_sets),
+                "counters": list(self._import_counter_acc.items()),
+                "gauges": list(self._import_gauge_acc.items()),
+            }
+            return {
+                "fingerprint": drecords.engine_fingerprint(
+                    self.cfg, self.histo_bank.num_centroids),
+                "gauge_seq": self._gauge_seq,
+                "last_import_op": self.last_import_op,
+                "interner": interner,
+                "banks": banks,
+                "staged": staged,
+                "piles_total": piles_total,
+                "piles_dirty": piles_dirty,
+            }
+
+    def restore_checkpoint(self, fingerprint, gauge_seq: int,
+                           watermark: int, interner: dict, banks: dict,
+                           staged: dict):
+        """Rebuild this (freshly constructed) engine from a decoded
+        checkpoint group: leaves are composed on host from the exact
+        fresh-init baseline plus the journaled rows, then committed to
+        the device in one device_put per leaf. Raises ValueError on a
+        shape-fingerprint mismatch — the Server refuses the whole
+        recovery loudly rather than scattering rows into wrong slots."""
+        from ..durability import records as drecords
+        want = drecords.engine_fingerprint(self.cfg,
+                                           self.histo_bank.num_centroids)
+        if tuple(fingerprint) != want:
+            raise ValueError(
+                f"engine checkpoint fingerprint {tuple(fingerprint)} "
+                f"does not match this engine's shape {want}")
+        with self.lock:
+            new_banks = {}
+            for kind, attr, _ki in self._bank_table():
+                bank = getattr(self, attr)
+                ids, leaves = banks.get(kind, (np.zeros(0, np.int32), {}))
+                if len(ids) == 0:
+                    new_banks[attr] = bank     # fresh rows, already right
+                    continue
+                host = {}
+                for name in drecords.BANK_LEAVES[kind]:
+                    # fetch the fresh-init baseline (exact: vmin=+inf
+                    # rows etc. come from the same _fresh_fn output the
+                    # live process swapped in), overlay the rows
+                    full = np.array(np.asarray(getattr(bank, name)))
+                    full[ids] = leaves[name]
+                    host[name] = jax.device_put(full, self._device)
+                new_banks[attr] = type(bank)(**host)
+            # SR02 invariant note: the histo rows restored above are
+            # bit-exact copies of rows an invariant-holding compress
+            # wrote before the checkpoint — restore preserves whatever
+            # cluster order the owning kernel produced
+            self.histo_bank = new_banks["histo_bank"]
+            self.counter_bank = new_banks["counter_bank"]
+            self.gauge_bank = new_banks["gauge_bank"]
+            self.set_bank = new_banks["set_bank"]
+            for kind, _attr, ki in self._bank_table():
+                interval, entries = interner.get(kind, (0, []))
+                ki.restore(interval, entries)
+                # restored rows deviate from fresh: the next checkpoint
+                # must serialize them again
+                ids, _leaves = banks.get(kind,
+                                         (np.zeros(0, np.int32), {}))
+                if self._dirty is not None and len(ids):
+                    self._dirty[kind][ids] = True
+            self._import_centroids = [
+                (int(s), np.asarray(m, np.float32),
+                 np.asarray(w, np.float32), float(a), float(b),
+                 float(c), float(d), float(e))
+                for s, m, w, a, b, c, d, e in staged.get("centroids", [])]
+            self._import_centroid_total = sum(
+                len(m) for _s, m, *_rest in self._import_centroids)
+            self._import_sets = [(int(s), np.asarray(r, np.uint8))
+                                 for s, r in staged.get("sets", [])]
+            self._import_counter_acc = {
+                int(s): float(v) for s, v in staged.get("counters", [])}
+            self._import_gauge_acc = {
+                int(s): float(v) for s, v in staged.get("gauges", [])}
+            self._gauge_seq = int(gauge_seq)
+            self.last_import_op = int(watermark)
+
+    def dirty_stats(self) -> tuple:
+        """(dirty piles, total piles) across the four banks — the
+        veneur.durability.engine_snapshot_piles_* gauges."""
+        if self._dirty is None:
+            return (0, 0)
+        with self.lock:
+            return (sum(int(d.sum()) for d in self._dirty),
+                    sum(d.size for d in self._dirty))

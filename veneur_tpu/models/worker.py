@@ -96,6 +96,34 @@ class KeyInterner:
         return [(k, i.slot, i.scope, i) for k, i in self._map.items()
                 if i.last_interval == cur]
 
+    def snapshot_entries(self) -> list:
+        """The full table as (slot, scope, last_interval, name, type,
+        joined_tags) rows — the engine checkpoint's ENGINE_KEYS payload
+        (durability/ ISSUE 9). Map order (= insertion order) is
+        preserved so a restored interner iterates like the original."""
+        return [(info.slot, info.scope, info.last_interval,
+                 k.name, k.type, k.joined_tags)
+                for k, info in self._map.items()]
+
+    def restore(self, interval: int, entries: list):
+        """Rebuild the table from a checkpoint (recovery-before-listen).
+        The free list is reconstructed canonically (unused slots,
+        allocation resuming from the lowest) — free-list ORDER only
+        decides which slot a future key gets, and slots are internal:
+        flushed values are keyed by metric name either way. The
+        presentation cache starts cold (it re-fills on first flush)."""
+        self.interval = int(interval)
+        self._map.clear()
+        self._by_slot = [None] * self.capacity
+        for slot, scope, last_interval, name, mtype, tags in entries:
+            key = MetricKey(name, mtype, tags)
+            self._map[key] = SlotInfo(int(slot), int(last_interval),
+                                      int(scope))
+            self._by_slot[int(slot)] = key
+        used = {info.slot for info in self._map.values()}
+        self._free = [s for s in range(self.capacity - 1, -1, -1)
+                      if s not in used]
+
     def advance_interval(self):
         """Called at each flush boundary: ages entries and evicts those
         idle longer than the TTL, returning their slots to the free list."""

@@ -42,6 +42,19 @@ log = logging.getLogger("veneur_tpu.server")
 _STOP = object()
 
 
+def _fold_rewrite(pb, fr) -> int:
+    """Apply an ImportFoldReroute's rewrite to the pb IN PLACE (the
+    fold key is tagless by construction) and return the fold key's
+    digest — the single-homed fold routing basis. One definition for
+    all three sites that re-route a fold (per-metric worker path,
+    ImportedBatch worker path, recovery replay): the rewrite diverging
+    between live and replay would silently break the kill-restart
+    bit-identity."""
+    pb.name = fr.key.name
+    del pb.tags[:]
+    return fr.digest
+
+
 class Server:
     def __init__(self, cfg: Config, sinks: list[MetricSink] | None = None,
                  plugins=None, forwarder=None, span_sinks=None):
@@ -257,6 +270,63 @@ class Server:
                 # data has had a full interval to land and flush — is
                 # safe to make a durable hard-drop floor
                 self._pending_watermarks: dict = {}
+        # Global-tier engine checkpointing (durability/ ISSUE 9): the
+        # piece the watermark journal alone cannot give — an interval
+        # the global ADMITTED AND ACKED is never replayed by its
+        # sender, so its merged sketch state used to die with the
+        # process. When armed, every admitted import op is write-ahead
+        # journaled (inside _submit_import_batch, before the worker
+        # queues and therefore before the ack), and each flush
+        # boundary appends a self-contained per-engine delta
+        # checkpoint (dirty piles + interner tables + staged imports +
+        # the applied-op watermark). Recovery runs HERE, before any
+        # listener binds: restore the latest checkpoint group per
+        # engine, then replay ops above each engine's watermark
+        # through the normal digest routing — the restarted global
+        # flushes BIT-IDENTICAL state (chaos-gated in
+        # tests/test_exactly_once_chaos.py).
+        self._engine_journal = None
+        self._engine_journal_armed = False
+        self._recovery = None            # restore stats for /debug, health
+        self._recovering = False         # True until start() completes
+        self._next_import_op = 0
+        self._recent_import_ops: list = []   # (op_id, bytes), 2-tick window
+        self._ops_at_last_checkpoint = 0
+        self._last_checkpoint_sig = None
+        self._last_checkpoint_t = None
+        self._last_checkpoint_stats = (0, 0)   # (dirty, total) piles
+        self._import_submit_lock = threading.Lock()
+        # Arming keys on the IMPORT tiers (a gRPC import listener or a
+        # declared global), NOT on http_address alone: http_address is
+        # also just the ops/healthcheck listener on sending-tier
+        # servers, which would otherwise pay dirty-bitmap marking on
+        # the UDP hot path plus a per-tick checkpoint+fsync for state
+        # that is never write-aheaded (UDP is lossy by contract). A
+        # global that receives ONLY over HTTP /import must set
+        # `is_global: true` to get checkpointing.
+        if cfg.durability_enabled and cfg.durability_engine_snapshot \
+                and (cfg.grpc_listen_addresses or cfg.is_global):
+            if self._mesh_mode or self.native_bridge is not None:
+                log.warning(
+                    "durability_engine_snapshot has no effect with a "
+                    "mesh engine or native_ingest (the %s owns the "
+                    "banks/interner); engine checkpointing disabled",
+                    "mesh" if self._mesh_mode else "native bridge")
+            else:
+                from .durability import EngineJournal
+                self._engine_journal_armed = True
+                self._recovering = True
+                self._engine_journal = EngineJournal(
+                    cfg.durability_dir,
+                    fsync=cfg.durability_fsync,
+                    fsync_interval_s=_parse_interval(
+                        cfg.durability_fsync_interval),
+                    snapshot_journal_bytes=(
+                        cfg.durability_snapshot_journal_bytes))
+                for eng in self.engines:
+                    eng.enable_dirty_tracking(
+                        cfg.durability_engine_delta_threshold)
+                self._recover_engine_state()
         # Fleet-scope tracing, receiver half (observe/fleet.py): the
         # per-sender e2e/freshness view plus the import observer that
         # phase-attributes each import request and parents its spans on
@@ -654,6 +724,9 @@ class Server:
         self._threads.append(t)
         # vlint: disable=TH01 reason=monotonic one-way flag; readers
         # (health probes) tolerate either order around startup
+        self._recovering = False
+        # vlint: disable=TH01 reason=monotonic one-way flag; readers
+        # (health probes) tolerate either order around startup
         self._started = True
 
     def stop(self, *, grace: float | None = None, clock=time.monotonic,
@@ -731,7 +804,8 @@ class Server:
         # release the file handles, so a restart from the same
         # durability_dir starts clean (the crash path skips this — the
         # journal's torn-write tolerance covers it)
-        for j in (self._forward_journal, self._dedupe_journal):
+        for j in (self._forward_journal, self._dedupe_journal,
+                  self._engine_journal):
             if j is not None:
                 try:
                     j.close()
@@ -1071,6 +1145,295 @@ class Server:
         except queue.Full:
             self._count("worker.dropped")
 
+    # -------- engine checkpoint/restore (durability, ISSUE 9) --------
+
+    # in-memory write-ahead retention cap: ops kept for snapshot
+    # compaction (a two-checkpoint window normally holds a handful;
+    # thousands means compaction stopped running — bound it anyway)
+    MAX_RETAINED_IMPORT_OPS = 65536
+
+    def _engine_journal_failed(self, what: str):
+        """A failing disk must not fail imports or the flush tick: the
+        process degrades to the pre-durability in-memory contract,
+        counted and loud (same policy as the watermark journal)."""
+        resilience.DEFAULT_REGISTRY.incr("import",
+                                         "durability.journal_errors")
+        log.exception(
+            "engine %s journal op failed; DISABLING engine "
+            "checkpointing for this process (in-memory aggregation "
+            "unaffected; crash-restart recovery degrades)", what)
+        j, self._engine_journal = self._engine_journal, None
+        if j is not None:
+            try:
+                j.close()
+            except Exception:
+                pass
+
+    def _submit_import_batch(self, pairs, envelope=None):
+        """The durable import submit path (wired into importsrv and the
+        HTTP /import handler when engine checkpointing is armed): one
+        admitted request = one journal op, write-ahead BEFORE any
+        worker queue — and therefore before the sender's ack — then
+        grouped per target engine so the worker applies each engine's
+        share atomically under the op id (the watermark's consistent
+        cut). The submit lock makes journal order == queue order, so
+        recovery's replay reproduces the original per-engine
+        application order exactly. `envelope` (the request's already-
+        admitted idempotency envelope) rides in the op record so
+        recovery can re-seed the dedupe ledger — recovered state plus
+        a forgotten envelope would double-count the sender's replay."""
+        from .cluster.importsrv import ImportedBatch
+        from .durability import records as drecords
+        nq = len(self.worker_queues)
+        with self._import_submit_lock:
+            op_id = self._next_import_op = self._next_import_op + 1
+            if self._engine_journal is not None:
+                try:
+                    payload = drecords.encode_engine_import(
+                        op_id, [pb for _d, pb in pairs], envelope)
+                    self._engine_journal.append_import(payload)
+                    self._recent_import_ops.append((op_id, payload))
+                    if len(self._recent_import_ops) > \
+                            self.MAX_RETAINED_IMPORT_OPS:
+                        self._recent_import_ops.pop(0)
+                except Exception:
+                    self._engine_journal_failed("import write-ahead")
+            groups: dict[int, list] = {}
+            for digest, pb in pairs:
+                groups.setdefault(digest % nq, []).append(pb)
+            for qi, pbs in groups.items():
+                try:
+                    self.worker_queues[qi].put_nowait(
+                        ImportedBatch(op_id, pbs))
+                except queue.Full:
+                    # journaled but shed: recovery replays it, live
+                    # processing loses it — the pre-durability
+                    # backpressure contract, counted per metric
+                    self._count("worker.dropped", len(pbs))
+
+    def _recover_engine_state(self):
+        """Recovery-before-listen: rebuild the engines from the engine
+        journal — the LATEST self-contained checkpoint group per
+        engine, then every import op above that engine's applied-op
+        watermark, replayed in journal order through the same digest
+        routing and grouped apply the live path uses (what makes the
+        next flush bit-identical to a zero-crash oracle). Never raises
+        on corrupt state: a shape-fingerprint mismatch or undecodable
+        group drops the WHOLE recovery loudly (fresh start) rather
+        than scattering rows into wrong slots."""
+        from .cluster import wire
+        from .durability import records as drecords
+        from .utils.hashing import metric_digest
+        tel, S = self.telemetry, observe.SERVER_SCOPE
+        t0 = time.monotonic_ns()
+        recs = self._engine_journal.load_records()
+        latest: dict[int, dict] = {}    # committed groups only
+        pending: dict[int, dict] = {}   # groups awaiting their COMMIT
+        ops: list = []
+        for rec_type, payload in recs:
+            try:
+                if rec_type == drecords.REC_ENGINE_IMPORT:
+                    ops.append(drecords.decode_engine_import(payload))
+                    continue
+                elif rec_type == drecords.REC_ENGINE_META:
+                    idx, n_eng, wm, gseq, fpr = \
+                        drecords.decode_engine_meta(payload)
+                    pending[idx] = {"meta": (n_eng, wm, gseq, fpr),
+                                    "keys": {}, "banks": {},
+                                    "staged": {}}
+                elif rec_type == drecords.REC_ENGINE_KEYS:
+                    idx, kind, interval, entries = \
+                        drecords.decode_engine_keys(payload)
+                    if idx in pending:
+                        pending[idx]["keys"][kind] = (interval, entries)
+                elif rec_type == drecords.REC_ENGINE_BANK:
+                    idx, kind, ids, leaves = \
+                        drecords.decode_engine_bank(payload)
+                    if idx in pending:
+                        pending[idx]["banks"][kind] = (ids, leaves)
+                elif rec_type == drecords.REC_ENGINE_STAGED:
+                    idx, staged = drecords.decode_engine_staged(payload)
+                    if idx in pending:
+                        pending[idx]["staged"] = staged
+                elif rec_type == drecords.REC_ENGINE_COMMIT:
+                    # only a COMMITTED group supersedes the previous
+                    # one: a crash mid-append leaves META (whose
+                    # watermark would suppress op replay) without the
+                    # KEYS/BANK rows that back it — restoring that
+                    # would be silent data loss
+                    idx = drecords.decode_engine_commit(payload)
+                    if idx in pending:
+                        latest[idx] = pending.pop(idx)
+                # foreign kinds (another journal's records) are skipped
+            except Exception:
+                tel.incr(S, "durability.engine_recovery_errors")
+                log.exception("engine recovery: undecodable record "
+                              "(type %d) skipped", rec_type)
+        if pending:
+            tel.incr(S, "durability.engine_recovery_errors",
+                     len(pending))
+            log.warning(
+                "engine recovery: %d torn (uncommitted) checkpoint "
+                "group(s) dropped — falling back to the previous "
+                "complete group(s); ops above their watermark replay",
+                len(pending))
+        n = len(self.engines)
+        for idx, g in latest.items():
+            n_eng = g["meta"][0]
+            if idx >= n or n_eng != n:
+                log.error(
+                    "engine recovery REFUSED: checkpoint was taken "
+                    "under %d engine(s), this server runs %d — "
+                    "starting fresh (replaying ops against a "
+                    "different shard map would double/misplace data)",
+                    n_eng, n)
+                tel.incr(S, "durability.engine_recovery_errors")
+                self._recovery = {"refused": "engine count mismatch"}
+                return
+        restored = 0
+        try:
+            for idx, g in latest.items():
+                _n_eng, wm, gseq, fpr = g["meta"]
+                self.engines[idx].restore_checkpoint(
+                    fpr, gseq, wm, g["keys"], g["banks"], g["staged"])
+                restored += 1
+        except ValueError as e:
+            log.error("engine recovery REFUSED: %s — starting fresh", e)
+            tel.incr(S, "durability.engine_recovery_errors")
+            self._recovery = {"refused": str(e)}
+            return
+        replayed = metrics_replayed = 0
+        for op_id, pbs, env in ops:
+            if op_id > self._next_import_op:
+                self._next_import_op = op_id
+            if env is not None and self.dedupe_ledger is not None:
+                # re-seed the ledger with the envelope this op was
+                # admitted under: its merged state is being recovered,
+                # so the sender's ambiguous-failure replay of the same
+                # chunk must dedupe, not double-count (ops the
+                # retention window compacted away are covered by the
+                # durable watermark journal instead — the two windows
+                # interlock)
+                self.dedupe_ledger.admit(*env)
+            by_engine: dict[int, list] = {}
+            for pb in pbs:
+                try:
+                    key = wire.metric_key_of(pb)
+                    digest = metric_digest(key.name, key.type,
+                                           key.joined_tags)
+                except Exception:
+                    self._count("import.rejected")
+                    continue
+                by_engine.setdefault(digest % n, []).append(pb)
+            applied = False
+            reroutes: list = []
+            for ei, epbs in by_engine.items():
+                eng = self.engines[ei]
+                if op_id <= eng.last_import_op:
+                    continue   # inside the restored checkpoint already
+                rerouted, rejected = eng.import_list(op_id, epbs)
+                reroutes.extend(rerouted)
+                for _pb, e in rejected:
+                    self._count("import.rejected")
+                    log.warning("engine recovery: rejected corrupted "
+                                "journaled metric: %s", e)
+                applied = True
+                metrics_replayed += len(epbs)
+            # overload-defense folds homed on other engines replay
+            # AFTER every direct share: a reroute stamps the target's
+            # watermark to op_id, and doing that before the target's
+            # own direct share would make the loop above skip it
+            for fr, pb in reroutes:
+                digest = _fold_rewrite(pb, fr)
+                self.engines[digest % n].import_list(op_id, [pb])
+            if applied:
+                replayed += 1
+            # retain for the next compaction (recovery's conservative
+            # window: everything not provably inside every checkpoint)
+            self._recent_import_ops.append(
+                (op_id, drecords.encode_engine_import(op_id, pbs, env)))
+        restore_ns = time.monotonic_ns() - t0
+        tel.incr(S, "durability.engine_recovered_ops", replayed)
+        tel.incr(S, "durability.engine_recovered_metrics",
+                 metrics_replayed)
+        tel.set_gauge(S, "durability.engine_restore_ns", restore_ns)
+        self._recovery = {
+            "engines_restored": restored,
+            "ops_replayed": replayed,
+            "metrics_replayed": metrics_replayed,
+            "restore_ns": restore_ns,
+            "generation": self._engine_journal.generation(),
+        }
+        if restored or replayed:
+            log.info("engine recovery: %d engine checkpoint(s) "
+                     "restored, %d import op(s) (%d metrics) replayed "
+                     "in %.1fms", restored, replayed, metrics_replayed,
+                     restore_ns / 1e6)
+
+    def _engine_checkpoint(self):
+        """The flush-boundary hook: append one self-contained delta
+        checkpoint group per engine (dirty piles only — the swap
+        re-zeroed everything else), skip entirely when nothing changed
+        (an idle global must not grow the journal), and compact when
+        the journal outgrew its budget — the snapshot is the latest
+        groups plus the ops the two-checkpoint retention window still
+        holds (an op admitted longer ago has had a full interval to
+        drain into an engine and be covered by a watermark; the same
+        one-interval fuzz the watermark journal documents)."""
+        from .durability import records as drecords
+        tel, S = self.telemetry, observe.SERVER_SCOPE
+        recs: list = []
+        dirty = total = 0
+        staged_any = False
+        marks = []
+        n = len(self.engines)
+        for i, eng in enumerate(self.engines):
+            snap = eng.checkpoint_state()
+            recs.extend(drecords.encode_engine_checkpoint(i, n, snap))
+            dirty += snap["piles_dirty"]
+            total += snap["piles_total"]
+            staged_any = staged_any or any(
+                snap["staged"][f] for f in ("centroids", "sets",
+                                            "counters", "gauges"))
+            marks.append(snap["last_import_op"])
+        sig = (tuple(marks),
+               tuple(len(ki) for eng in self.engines
+                     for _k, _a, ki in eng._bank_table()))
+        # vlint: disable=TH01 reason=flush-path-only state; flushes are
+        # serialized (one flusher thread, tests call flush_once
+        # synchronously) and readers (debug/health) tolerate staleness
+        self._last_checkpoint_stats = (dirty, total)
+        if not dirty and not staged_any \
+                and sig == self._last_checkpoint_sig:
+            # nothing to persist: every pile is fresh, nothing staged,
+            # no new ops, no interner churn — the delta encoding's
+            # degenerate (and steady-state idle) case
+            tel.incr(S, "durability.engine_delta_skipped_piles", total)
+            return
+        nbytes = self._engine_journal.append_checkpoint(recs)
+        self._engine_journal.sync()
+        tel.set_gauge(S, "durability.engine_snapshot_bytes", nbytes)
+        tel.incr(S, "durability.engine_delta_skipped_piles",
+                 total - dirty)
+        # vlint: disable=TH01 reason=flush-path-only state; flushes are
+        # serialized (one flusher thread, tests call flush_once
+        # synchronously)
+        self._last_checkpoint_sig = sig
+        # vlint: disable=TH01 reason=flush-path-only state; debug-page
+        # readers tolerate staleness
+        self._last_checkpoint_t = time.monotonic()
+        with self._import_submit_lock:
+            cut = self._ops_at_last_checkpoint
+            self._recent_import_ops = [
+                o for o in self._recent_import_ops if o[0] > cut]
+            self._ops_at_last_checkpoint = self._next_import_op
+            retained = [(drecords.REC_ENGINE_IMPORT, p)
+                        for _id, p in self._recent_import_ops]
+            # compaction must run under the submit lock: an op
+            # appended between the retention snapshot and the journal
+            # truncate would be lost from both
+            self._engine_journal.maybe_compact(recs + retained)
+
     def _start_import_listener(self, addr: str):
         """Global-mode gRPC receive path (importsrv): forwarded metrics
         are re-hashed onto the worker queues and merged via Combine."""
@@ -1086,7 +1449,9 @@ class Server:
 
         server, port = start_import_server(
             addr, submit, ledger=self.dedupe_ledger,
-            observer=self.import_observer)
+            observer=self.import_observer,
+            submit_batch=(self._submit_import_batch
+                          if self._engine_journal is not None else None))
         self._grpc_servers.append(server)
         self.grpc_port = port
 
@@ -1112,6 +1477,8 @@ class Server:
             observer=self.import_observer,
             fleet_state=self._debug_fleet_state,
             health=self.health_state,
+            submit_batch=(self._submit_import_batch
+                          if self._engine_journal is not None else None),
             # the profiler trigger only exists when the operator opted
             # in via debug_flush_profile (a capture is a debug action)
             profile=(self.request_profile_capture
@@ -1182,7 +1549,7 @@ class Server:
     def _worker_loop(self, idx: int, q: queue.Queue):
         """[HOT LOOP 2] queue -> engine (Worker.Work +
         Worker.ImportMetricGRPC for forwarded metrics)."""
-        from .cluster.importsrv import ImportedMetric
+        from .cluster.importsrv import ImportedBatch, ImportedMetric
         from .cluster.wire import apply_metric_to_engine
         from .models import pipeline
 
@@ -1194,6 +1561,29 @@ class Server:
                     break
                 if isinstance(item, parser.UDPMetric):
                     eng.process(item)
+                elif isinstance(item, ImportedBatch):
+                    # durable import path: one journaled op's share for
+                    # this engine, applied atomically so the engine's
+                    # applied-op watermark is an exact replay cut
+                    rerouted, rejected = eng.import_list(item.op_id,
+                                                         item.pbs)
+                    for fr, pb in rerouted:
+                        # fold key homed on another engine: rewrite and
+                        # re-route under the SAME op id (single-homed
+                        # folds, as the per-metric path does)
+                        digest = _fold_rewrite(pb, fr)
+                        try:
+                            self.worker_queues[
+                                digest
+                                % len(self.worker_queues)].put_nowait(
+                                ImportedBatch(item.op_id, [pb]))
+                        except queue.Full:
+                            self._count("worker.dropped")
+                    for pb, e in rejected:
+                        self._count("import.rejected")
+                        log.warning(
+                            "rejected corrupted imported metric "
+                            "%r: %s", getattr(pb, "name", "?"), e)
                 elif isinstance(item, ImportedMetric):
                     # poison-pill guard: a corrupted forwarded payload
                     # (bad HLL blob, malformed centroid list) must
@@ -1207,11 +1597,10 @@ class Server:
                         # another engine — rewrite the aggregate onto
                         # it and re-route (single-homed folds; the
                         # home engine admits it as an ordinary import)
-                        item.pb.name = fr.key.name
-                        del item.pb.tags[:]
+                        digest = _fold_rewrite(item.pb, fr)
                         try:
                             self.worker_queues[
-                                fr.digest
+                                digest
                                 % len(self.worker_queues)].put_nowait(item)
                         except queue.Full:
                             self._count("worker.dropped")
@@ -1483,11 +1872,21 @@ class Server:
         dtok = None
         if tick is not None and (
                 self._forward_journal is not None
+                or self._engine_journal is not None
                 or (self._dedupe_journal is not None
                     and self.dedupe_ledger is not None)):
             dp = tick.start("durability")
             dtok = observe.set_current_tick(tick, dp)
         try:
+            if self._engine_journal is not None:
+                try:
+                    # engine delta checkpoint: the banks were just
+                    # swapped, so `fresh + dirty rows` is the whole
+                    # post-flush state; everything admitted since rides
+                    # the write-ahead import ops
+                    self._engine_checkpoint()
+                except Exception:
+                    self._engine_journal_failed("checkpoint")
             if self._forward_journal is not None:
                 jt = getattr(self.forwarder, "journal_tick", None)
                 if jt is not None:
@@ -1637,6 +2036,7 @@ class Server:
                 "watermark_journal_bytes": (
                     self._dedupe_journal.size_bytes()
                     if self._dedupe_journal is not None else None),
+                "engine_checkpoint": self._engine_checkpoint_state(),
             },
             "registry": {
                 "server": self.telemetry.debug_state(),
@@ -1650,6 +2050,31 @@ class Server:
                 "watermarks": self.dedupe_ledger.max_admitted(),
             }
         return state
+
+    def _engine_checkpoint_state(self) -> dict | None:
+        """The /debug/flush checkpoint block: generation, journal and
+        last-delta bytes, the dirty/total pile ratio of the last
+        boundary, the last-checkpoint age, and the restore stats of
+        this incarnation's recovery (None when the feature is off)."""
+        if not self._engine_journal_armed:
+            return None
+        j = self._engine_journal
+        dirty, total = self._last_checkpoint_stats
+        return {
+            "enabled": j is not None,   # False = degraded (disk error)
+            "generation": j.generation() if j is not None else None,
+            "journal_bytes": j.size_bytes() if j is not None else None,
+            "last_snapshot_bytes": (j.last_checkpoint_bytes
+                                    if j is not None else None),
+            "piles_dirty": dirty,
+            "piles_total": total,
+            "dirty_ratio": round(dirty / total, 6) if total else 0.0,
+            "last_checkpoint_age_s": (
+                round(time.monotonic() - self._last_checkpoint_t, 3)
+                if self._last_checkpoint_t is not None else None),
+            "pending_import_ops": len(self._recent_import_ops),
+            "restore": self._recovery,
+        }
 
     # health verdict threshold: a flush is STALLED once its lag exceeds
     # this many intervals (1.5 = the check flips within one interval of
@@ -1703,8 +2128,25 @@ class Server:
             if self.dedupe_ledger is not None \
                     and self._dedupe_journal is None:
                 degraded_journals.append("dedupe_watermarks")
+            if self._engine_journal_armed and self._engine_journal is None:
+                degraded_journals.append("engine")
             checks["journal"] = {"ok": not degraded_journals,
                                  "degraded": degraded_journals}
+        if self._engine_journal_armed or self._recovery is not None:
+            # recovery-before-listen verdict: in_progress until start()
+            # completes (the /ready "recovering" window), then the
+            # restore stats — what was restored/replayed and how long
+            # it took — stay on the page. A REFUSED recovery (shape
+            # fingerprint / engine-count mismatch: journaled state was
+            # discarded, fresh start) keeps ok=false so a monitor
+            # keying on status sees the data-loss condition, like the
+            # disk-failure path does via the journal check.
+            refused = bool((self._recovery or {}).get("refused"))
+            checks["recovery"] = {
+                "ok": not self._recovering and not refused,
+                "in_progress": self._recovering,
+                **(self._recovery or {}),
+            }
         if self.admission is not None:
             rate = self.admission.shed_rate
             checks["overload"] = {"ok": rate >= 1.0, "shed_rate": rate}
@@ -1712,10 +2154,13 @@ class Server:
                     default=0.0)
         checks["queues"] = {"ok": qfill < 0.9, "fill": round(qfill, 4)}
         degraded = any(not c["ok"] for c in checks.values())
+        recovering = self._recovering
         return {
             "healthy": not stalled,
-            "ready": started and not self._stop.is_set(),
-            "status": ("stalled" if stalled
+            "ready": started and not recovering
+                     and not self._stop.is_set(),
+            "status": ("recovering" if recovering
+                       else "stalled" if stalled
                        else "degraded" if degraded else "ok"),
             "checks": checks,
         }
@@ -1840,8 +2285,28 @@ class Server:
         if self.dedupe_ledger is not None:
             tel.set_gauge(S, "forward.dedupe_ledger_size",
                           self.dedupe_ledger.size())
+        if self._engine_journal is not None:
+            # engine-checkpoint self-metrics, present-at-zero while the
+            # feature is armed (a zero delta-skip/dirty tick IS the
+            # steady-state signal); the recovered_* counters were
+            # incr'd during recovery-before-listen and drain here
+            for name in ("durability.engine_delta_skipped_piles",
+                         "durability.engine_recovered_ops",
+                         "durability.engine_recovered_metrics",
+                         "durability.engine_recovery_errors"):
+                tel.mark(S, name, 0)
+            dirty, total = self._last_checkpoint_stats
+            tel.set_gauge(S, "durability.engine_snapshot_piles_dirty",
+                          dirty)
+            tel.set_gauge(S, "durability.engine_snapshot_piles_total",
+                          total)
+            tel.set_gauge(S, "durability.engine_snapshot_bytes",
+                          self._engine_journal.last_checkpoint_bytes)
+            tel.set_gauge(S, "durability.engine_restore_ns",
+                          (self._recovery or {}).get("restore_ns", 0))
         journals = [j for j in (self._forward_journal,
-                                self._dedupe_journal) if j is not None]
+                                self._dedupe_journal,
+                                self._engine_journal) if j is not None]
         if journals:
             # counters (journal_appends/truncated_frames/recovered_*)
             # ride the process registry's drain below; the level-style
