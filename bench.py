@@ -167,8 +167,12 @@ def worker(k: int, budget_s: float, platform: str,
     # finalization in one XLA call) — not a bench-only kernel.
     qs = np.asarray([0.5, 0.75, 0.99], np.float32)
     agg_emit = ("min", "max", "count")
+    from veneur_tpu.sketches.hll_engine import HLLEngine
+    from veneur_tpu.sketches.tdigest_engine import TDigestEngine
+    heng = TDigestEngine(compression=COMPRESSION, buffer_depth=BUF)
+    seng = HLLEngine(precision=14)
     prog = pipeline._flush_executable(
-        dev, COMPRESSION, False, agg_emit, plat in ("tpu", "axon"))
+        dev, heng, seng, False, agg_emit, plat in ("tpu", "axon"))
     small = jax.device_put(
         (scalar.init_counters(16), scalar.init_gauges(16),
          hll.init(16, 14)), dev)
@@ -223,7 +227,7 @@ def worker(k: int, budget_s: float, platform: str,
     # second compile would eat the CPU worker's whole budget.
     if plat == "tpu" and time.monotonic() < deadline - 30.0:
         prog_nd = pipeline._flush_executable(
-            dev, COMPRESSION, False, agg_emit,
+            dev, heng, seng, False, agg_emit,
             plat in ("tpu", "axon"), donate=False)
         scalar_of = jax.jit(jnp.sum)
         args = jax.tree_util.tree_map(jnp.copy, (bank,) + small)
@@ -343,7 +347,7 @@ def worker(k: int, budget_s: float, platform: str,
             best_base = min(mode_table, key=mode_table.get)
             try:
                 prog_c = pipeline._flush_executable(
-                    dev, COMPRESSION, False, agg_emit,
+                    dev, heng, seng, False, agg_emit,
                     plat in ("tpu", "axon"), compact=True)
                 # round 0 pays the compact program's compile; dropped
                 probe_mode(best_base + "+f16", prog_c, best_base,
@@ -362,7 +366,7 @@ def worker(k: int, budget_s: float, platform: str,
                 jax.block_until_ready(copy)
                 t0 = time.monotonic()
                 aot = pipeline._flush_executable(
-                    dev, COMPRESSION, False, agg_emit, True,
+                    dev, heng, seng, False, agg_emit, True,
                     donate=False).lower(*copy, qs).compile()
                 _log(f"worker: AOT compile {time.monotonic() - t0:.1f}s")
                 probe_mode("aot_sync", aot, "sync", None)

@@ -1794,6 +1794,163 @@ tpu_buffer_depth: 256
                      "byte form")
 
 
+def config17_sketch_engines():
+    """Pluggable sketch engines (ISSUE 10): per-engine add_batch /
+    import-merge / flush timing at the c12 1.6k shape and the 100k
+    shape, state-bytes rows, and the two acceptance rows —
+
+      * ULL register bank bytes <= 0.75x the HLL bank at equal nominal
+        error (p=13 vs p=14, both in the ~1% class: literally 0.5x in
+        this u8 layout);
+      * REQ p99.9 relative error <= 1% on the heavy-tail (pareto 1.5)
+        stream where the same-budget t-digest row exceeds it.
+
+    Wall rows on this box are noisy (virtualized CPU, ±30% drift —
+    the r8/r10 caveat); the state-bytes and accuracy rows are exact.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.models.pipeline import (AggregationEngine,
+                                            EngineConfig)
+    from veneur_tpu.sketches.hll_engine import HLLEngine
+    from veneur_tpu.sketches.req import REQEngine
+    from veneur_tpu.sketches.tdigest_engine import TDigestEngine
+    from veneur_tpu.sketches.ull import ULLEngine
+
+    rng = np.random.default_rng(17)
+    B = 8192
+
+    # ---- state bytes (exact) ----
+    hll, ull = HLLEngine(precision=14), ULLEngine(precision=13)
+    td, req = TDigestEngine(), REQEngine()
+    _emit("c17_hll_register_bytes_per_slot", hll.state_bytes(1),
+          "bytes", None)
+    _emit("c17_ull_register_bytes_per_slot", ull.state_bytes(1),
+          "bytes", None)
+    _emit("c17_ull_vs_hll_state_ratio",
+          ull.state_bytes(1) / hll.state_bytes(1), "ratio", 0.75,
+          larger_is_better=False,
+          note="acceptance: <= 0.75 at equal ~1% nominal error "
+               f"(hll stderr {hll.nominal_error():.4f}, "
+               f"ull stderr {ull.nominal_error():.4f})")
+    _emit("c17_tdigest_bank_bytes_per_slot", td.state_bytes(1),
+          "bytes", None)
+    _emit("c17_req_bank_bytes_per_slot", req.state_bytes(1),
+          "bytes", None)
+
+    # ---- accuracy rows (exact, fixed seed) ----
+    n = 100_000
+    pareto = ((1.0 / (1.0 - rng.uniform(0, 1, n))) ** (1 / 1.5)) \
+        .astype(np.float32)
+    exact999 = float(np.percentile(pareto.astype(np.float64), 99.9))
+
+    def fill_hist(eng):
+        add = jax.jit(eng.add_batch_impl)
+        bank = eng.init(4)
+        for i in range(0, n, B):
+            chunk = pareto[i:i + B]
+            slots = np.zeros(B, np.int32)
+            slots[len(chunk):] = -1
+            v = np.zeros(B, np.float32)
+            v[:len(chunk)] = chunk
+            bank = add(bank, jnp.asarray(slots), jnp.asarray(v),
+                       jnp.asarray(np.ones(B, np.float32)))
+        return bank, add
+
+    qs = jnp.asarray([0.999], jnp.float32)
+    for name, eng in (("tdigest", td), ("req", req)):
+        bank, add = fill_hist(eng)
+        bank = jax.jit(eng.compress_impl)(bank)
+        q = float(np.asarray(jax.jit(eng.quantile_impl)(bank, qs))[0, 0])
+        err = abs(q - exact999) / exact999 * 100.0
+        _emit(f"c17_{name}_p999_rel_err_pct", err, "%",
+              1.0 if name == "req" else None, larger_is_better=False,
+              note="pareto(1.5) 100k stream; acceptance: req <= 1% "
+                   "where the same-budget t-digest exceeds it")
+        # per-engine add_batch wall at the 8192 batch
+        t0 = time.monotonic()
+        for _ in range(8):
+            bank = add(bank, jnp.asarray(np.zeros(B, np.int32)),
+                       jnp.asarray(pareto[:B]),
+                       jnp.asarray(np.ones(B, np.float32)))
+        jax.block_until_ready(bank)
+        _emit(f"c17_{name}_add_batch_ms", (time.monotonic() - t0)
+              / 8 * 1000, "ms", None, larger_is_better=False)
+
+    from veneur_tpu.utils.hashing import set_member_hash
+    hashes = np.array([set_member_hash(f"u{i}") for i in range(n)],
+                      np.uint64)
+    for name, eng in (("hll", hll), ("ull", ull)):
+        ins = jax.jit(eng.insert_impl)
+        bank = eng.init(4)
+        idx, vals = eng.host_hash_to_updates(hashes)
+        t0 = time.monotonic()
+        for i in range(0, n, B):
+            seg = slice(i, min(n, i + B))
+            m = seg.stop - seg.start
+            s = np.full(B, -1, np.int32)
+            s[:m] = 0
+            ip = np.zeros(B, np.int32)
+            ip[:m] = idx[seg]
+            vp = np.zeros(B, np.uint8)
+            vp[:m] = vals[seg]
+            bank = ins(bank, jnp.asarray(s), jnp.asarray(ip),
+                       jnp.asarray(vp))
+        jax.block_until_ready(bank)
+        _emit(f"c17_{name}_insert_100k_ms",
+              (time.monotonic() - t0) * 1000, "ms", None,
+              larger_is_better=False,
+              note=("lattice-join insert: sort+scan+dedup per batch "
+                    "— XLA-CPU pays the scan; scatter-max rides the "
+                    "fast path" if name == "ull" else "scatter-max"))
+        host = jax.device_get(eng.estimate_device(bank, False))
+        host = {k: np.asarray(v) for k, v in host.items()}
+        t0 = time.monotonic()
+        eng.estimate_finalize(host)
+        est = float(host["s_est"][0])
+        _emit(f"c17_{name}_estimate_rel_err_pct",
+              abs(est - n) / n * 100.0, "%", None,
+              larger_is_better=False,
+              finalize_ms=round((time.monotonic() - t0) * 1000, 3))
+
+    # ---- full-engine flush wall: c12 1.6k shape and the 100k shape ----
+    def flush_rows(label, hb, sb, hslots, reps):
+        eng = AggregationEngine(EngineConfig(
+            histogram_slots=hslots, counter_slots=256, gauge_slots=128,
+            set_slots=128, batch_size=B, histogram_backend=hb,
+            set_backend=sb))
+        eng.warmup()
+        from veneur_tpu.ingest.parser import MetricKey
+        # touch 1/8 of the slots; flush includes compress + quantiles +
+        # estimate + assembly (the serving tick's engine leg)
+        keys = max(64, hslots // 8)
+        for k in range(keys):
+            key = MetricKey(f"b.t{k}", "timer", "")
+            slot = eng.histo_keys.lookup(key, 0)
+        slots = rng.integers(0, keys, B).astype(np.int32)
+        vals_ = rng.lognormal(3, 1, B).astype(np.float32)
+        eng.ingest_histo_batch(slots, vals_,
+                               np.ones(B, np.float32))
+        eng.flush()          # warm the flush path
+        eng.ingest_histo_batch(slots, vals_, np.ones(B, np.float32))
+        times = []
+        for _ in range(reps):
+            eng.ingest_histo_batch(slots, vals_,
+                                   np.ones(B, np.float32))
+            t0 = time.monotonic()
+            eng.flush()
+            times.append(time.monotonic() - t0)
+        _emit(f"c17_{label}_flush_ms_{hslots}",
+              min(times) * 1000, "ms", None, larger_is_better=False,
+              note="min over reps; engine flush incl. assembly")
+
+    for hb, sb, label in (("tdigest", "hll", "tdigest_hll"),
+                          ("req", "ull", "req_ull")):
+        flush_rows(label, hb, sb, 1024, 4)
+        flush_rows(label, hb, sb, 100_352, 2)
+
+
 CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            3: config3_sets_1m_uniques, 4: config4_forward_merge_32_shards,
            5: config5_multichip_100k, 6: config6_e2e_udp_ingest,
@@ -1804,7 +1961,8 @@ CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            13: config13_flight_recorder,
            14: config14_admission_defense,
            15: config15_fleet_tracing,
-           16: config16_engine_checkpoint}
+           16: config16_engine_checkpoint,
+           17: config17_sketch_engines}
 
 
 def _run_isolated(configs: list[int], json_out: str) -> int:

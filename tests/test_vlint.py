@@ -207,3 +207,31 @@ def test_violation_str_is_clickable():
     vs = run_paths([os.path.join(FIX, "jx01_bad.py")])
     assert str(vs[0]).startswith(
         os.path.join(FIX, "jx01_bad.py").replace(os.sep, "/") + ":7: ")
+
+
+def test_sk01_sketch_boundary_violations():
+    # direct sketch-module imports (5, 7, 9), bank constructions (15 —
+    # which also trips SR02's mean/weight heuristic — and 19); the
+    # docstring mention, the suppressed bench exception, and the
+    # registry-obtained engine stay silent
+    assert lint("sk01_bad.py") == [
+        ("SK01", 5), ("SK01", 7), ("SK01", 9), ("SK01", 15),
+        ("SR02", 15), ("SK01", 19)]
+
+
+def test_sk01_registry_and_ops_are_allowed():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in (("veneur_tpu", "sketches", "ull.py"),
+                ("veneur_tpu", "sketches", "tdigest_engine.py"),
+                ("veneur_tpu", "ops", "tdigest.py"),
+                ("veneur_tpu", "parallel", "mesh.py")):
+        path = os.path.join(repo, *rel)
+        assert [v for v in run_paths([path]) if v.rule == "SK01"] == []
+
+
+def test_sk01_pipeline_routes_through_registry():
+    # the refactored pipeline holds engine objects only — a future
+    # direct ops import there is exactly the drift SK01 exists for
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "models", "pipeline.py")
+    assert [v for v in run_paths([path]) if v.rule == "SK01"] == []

@@ -36,9 +36,13 @@ DEFAULT_CONFIG = {
     ),
     # SR02: the one module allowed to write TDigestBank.mean/weight —
     # it owns the sorted-prefix invariant the merge-path compress
-    # depends on for correctness.
+    # depends on for correctness. sketches/req.py is allowed because
+    # its REQBank NamedTuple ALSO carries a `weight` field (the
+    # compactor item weights — no cluster-order invariant applies to
+    # them) and SR02's _replace heuristic matches by field name.
     "sr02_allow": (
         "veneur_tpu/ops/tdigest.py",
+        "veneur_tpu/sketches/req.py",
     ),
     # DR01: where the durable-state write discipline applies (path
     # substring match; the /dr01_ entry scopes the check's own test
@@ -90,6 +94,23 @@ DEFAULT_CONFIG = {
     ),
     "tl01_allow": (
         "veneur_tpu/observe/registry.py",
+    ),
+    # SK01: sketch-engine registry boundary (path substring match;
+    # /sk01_ scopes the check's own fixture in). Sketch banks and
+    # sketch math live in veneur_tpu/sketches/ + the blessed ops/
+    # kernels; everywhere else holds engine objects from the registry.
+    # parallel/ is allowed: the mesh engine owns its sharded banks
+    # directly on the t-digest/HLL ops, and the backend selection
+    # refuses non-default engines there (config validation + the mesh
+    # constructor guard).
+    "sk01_scope": (
+        "veneur_tpu/",
+        "/sk01_",
+    ),
+    "sk01_allow": (
+        "veneur_tpu/sketches/",
+        "veneur_tpu/ops/",
+        "veneur_tpu/parallel/",
     ),
     # TR01: where the trace-context wire-literal monopoly applies
     # (path substring match; /tr01_ scopes the check's own fixture in)

@@ -1040,6 +1040,80 @@ def check_ov01(mod: PyModule, config: dict) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------------------- SK01
+
+_SK01_BANKS = ("TDigestBank", "HLLBank", "ULLBank", "REQBank")
+# module tails that ARE sketch implementations: importing one outside
+# the registry boundary is direct sketch-math access
+_SK01_MODULES = ("ops.tdigest", "ops.hll", "ops.pallas_hll",
+                 "sketches.ull", "sketches.req",
+                 "sketches.tdigest_engine", "sketches.hll_engine")
+_SK01_LEAF_NAMES = ("tdigest", "hll", "pallas_hll", "ull", "req",
+                    "tdigest_engine", "hll_engine")
+
+
+def check_sk01(mod: PyModule, config: dict) -> list[Violation]:
+    """Sketch-engine registry boundary (ISSUE 10): sketch banks and
+    sketch math are owned by veneur_tpu/sketches/ (the engine registry)
+    and the blessed veneur_tpu/ops/ kernels. Outside those, code must
+    hold an ENGINE OBJECT from the registry — flagged here are (a)
+    imports of the sketch implementation modules (ops.tdigest, ops.hll,
+    sketches.ull, ...; a direct import is how a call site grows a
+    hard-wired dependency on one engine's math and silently breaks the
+    other backend) and (b) construction of the bank NamedTuples
+    (TDigestBank/HLLBank/ULLBank/REQBank — a bank built outside the
+    owning engine bypasses its invariants: cluster order, register
+    packing, level layout). The mesh engine (parallel/) is allowed by
+    config — it owns sharded banks and the backend selection refuses
+    non-default engines there; intentional exceptions elsewhere
+    suppress with a reason."""
+    if not any(m in mod.path for m in config["sk01_scope"]):
+        return []
+    if any(a in mod.path for a in config["sk01_allow"]):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            hit = any(module.endswith(t) or module == t.rsplit(".")[-1]
+                      for t in _SK01_MODULES)
+            names = {a.name for a in node.names}
+            # `from ..ops import tdigest, hll` / `from ..sketches
+            # import ull` forms: the module is the parent package and
+            # the implementation rides in the names list
+            if not hit and (module.endswith("ops")
+                            or module.endswith("sketches")):
+                hit = bool(names & set(_SK01_LEAF_NAMES))
+            if hit:
+                out.append(Violation(
+                    mod.path, node.lineno, "SK01",
+                    f"direct sketch-module import ({module!r}) outside "
+                    "the registry boundary — obtain an engine object "
+                    "from veneur_tpu.sketches (histogram_engine/"
+                    "set_engine) instead, or suppress with a reason"))
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if any(a.name.endswith(t) for t in _SK01_MODULES):
+                    out.append(Violation(
+                        mod.path, node.lineno, "SK01",
+                        f"direct sketch-module import ({a.name!r}) "
+                        "outside the registry boundary — obtain an "
+                        "engine object from veneur_tpu.sketches "
+                        "instead, or suppress with a reason"))
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None and d.rsplit(".", 1)[-1] in _SK01_BANKS:
+                out.append(Violation(
+                    mod.path, node.lineno, "SK01",
+                    f"{d.rsplit('.', 1)[-1]} constructed outside "
+                    "veneur_tpu/sketches/ + the blessed ops/ kernels — "
+                    "banks built outside the owning engine bypass its "
+                    "invariants (cluster order, register packing, "
+                    "level layout); build through the engine object or "
+                    "suppress with a reason"))
+    return out
+
+
 # ------------------------------------------------------------------- driver
 
 def check_module(mod: PyModule, ctx: Context, config: dict
@@ -1057,4 +1131,5 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_tl01(mod, config))
     out.extend(check_tr01(mod, config))
     out.extend(check_ov01(mod, config))
+    out.extend(check_sk01(mod, config))
     return out

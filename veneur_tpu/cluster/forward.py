@@ -37,10 +37,14 @@ class GrpcForwarder:
     def __init__(self, address: str, timeout_s: float = 10.0,
                  max_per_batch: int = 10_000,
                  egress: Egress | None = None,
-                 egress_policy: EgressPolicy | None = None):
+                 egress_policy: EgressPolicy | None = None,
+                 engine_stamp: str | None = None):
         self.address = address
         self.timeout_s = timeout_s
         self.max_per_batch = max_per_batch
+        # sketch-engine/wire-format stamp declared on every chunk
+        # (ISSUE 10 mixed-fleet safety); None = legacy (unstamped)
+        self.engine_stamp = engine_stamp
         self._egress = egress or Egress(f"grpc://{address}",
                                         policy=egress_policy)
         self._channel = grpc_channel(address)
@@ -71,6 +75,12 @@ class GrpcForwarder:
             i = j * self.max_per_batch
             batch = forward_pb2.MetricList(
                 metrics=metrics[i:i + self.max_per_batch])
+            if self.engine_stamp:
+                batch.sketch_engines = self.engine_stamp
+            if j == 0 and export.prefix_sketches:
+                # advisory cardinality rows ride the first chunk only
+                # (merge-by-max is idempotent across replays)
+                wire.prefix_sketches_to_pb(batch, export.prefix_sketches)
             if envelope is not None:
                 batch.envelope.CopyFrom(wire.envelope_pb(
                     envelope.sender_id, envelope.interval_seq,
@@ -89,7 +99,8 @@ class GrpcForwarder:
                     _export_tail(export, i), e, delivered_chunks=j,
                     chunk_count=total or n_chunks) from e
 
-    def send_metrics(self, metrics: list, envelope=None):
+    def send_metrics(self, metrics: list, envelope=None,
+                     sketch_engines=None, prefix_sketches=None):
         """Ship raw metricpb.Metrics (used by the proxy's re-batching),
         batches retried under one shared deadline budget. `envelope` is
         a received forwardrpc.Envelope passed through UNMODIFIED (the
@@ -98,18 +109,30 @@ class GrpcForwarder:
         whole group ships as ONE list under the original ids; that is
         size-safe because the group is a subset of a single MetricList
         that already fit through this proxy's inbound gRPC message
-        limit, so it cannot exceed a same-configured outbound limit."""
+        limit, so it cannot exceed a same-configured outbound limit.
+        `sketch_engines`/`prefix_sketches` are likewise passed through
+        verbatim (a proxy that stripped the engine stamp would make a
+        non-default fleet read as legacy and be refused downstream)."""
         deadline = self._egress.deadline()
         if envelope is not None:
             batch = forward_pb2.MetricList(metrics=metrics)
             batch.envelope.CopyFrom(envelope)
+            if sketch_engines:
+                batch.sketch_engines = sketch_engines
+            if prefix_sketches:
+                wire.prefix_sketches_to_pb(batch, prefix_sketches)
             self._egress.call(self._send, batch,
                               timeout_s=self.timeout_s,
                               deadline=deadline)
             return
-        for i in range(0, len(metrics), self.max_per_batch):
+        for j, i in enumerate(range(0, len(metrics),
+                                    self.max_per_batch)):
             batch = forward_pb2.MetricList(
                 metrics=metrics[i:i + self.max_per_batch])
+            if sketch_engines:
+                batch.sketch_engines = sketch_engines
+            if j == 0 and prefix_sketches:
+                wire.prefix_sketches_to_pb(batch, prefix_sketches)
             self._egress.call(self._send, batch,
                               timeout_s=self.timeout_s,
                               deadline=deadline)
@@ -157,10 +180,12 @@ class HttpJsonForwarder:
     def __init__(self, base_url: str, timeout_s: float = 10.0,
                  max_per_body: int = 25_000,
                  egress: Egress | None = None,
-                 egress_policy: EgressPolicy | None = None):
+                 egress_policy: EgressPolicy | None = None,
+                 engine_stamp: str | None = None):
         self.url = base_url.rstrip("/") + "/import"
         self.timeout_s = timeout_s
         self.max_per_body = max_per_body
+        self.engine_stamp = engine_stamp
         self._egress = egress or Egress(self.url, policy=egress_policy)
 
     @staticmethod
@@ -184,7 +209,8 @@ class HttpJsonForwarder:
         for key, regs in export.sets:
             body.append({"name": key.name, "type": "set",
                          "tags": wire._split_tags(key.joined_tags),
-                         "set": wire.encode_hll(regs).hex()})
+                         "set": wire.encode_set_payload(
+                             export.set_engine, regs).hex()})
         for key, value in export.counters:
             body.append({"name": key.name, "type": "counter",
                          "tags": wire._split_tags(key.joined_tags),
@@ -213,6 +239,14 @@ class HttpJsonForwarder:
             i = j * self.max_per_body
             headers = {"Content-Type": "application/json",
                        "X-Veneur-Forward-Version": self.FORMAT}
+            if self.engine_stamp:
+                headers[wire.SKETCH_HEADER] = self.engine_stamp
+            if j == 0 and export.prefix_sketches:
+                # headers have practical size limits: cap the advisory
+                # rows (the pb contract carries the full set)
+                headers[wire.PREFIX_SKETCH_HEADER] = \
+                    wire.encode_prefix_sketches_header(
+                        export.prefix_sketches[:32])
             if envelope is not None:
                 headers.update(wire.envelope_headers(
                     envelope.sender_id, envelope.interval_seq,
@@ -248,7 +282,8 @@ class DiscoveringForwarder:
                  refresh_interval_s: float = 30.0, use_grpc: bool = True,
                  forwarder_factory=None, timeout_s: float = 10.0,
                  max_per_body: int = 25_000,
-                 egress_policy: EgressPolicy | None = None):
+                 egress_policy: EgressPolicy | None = None,
+                 engine_stamp: str | None = None):
         self.discoverer = discoverer
         self.service = service
         self.refresh_interval_s = refresh_interval_s
@@ -256,13 +291,15 @@ class DiscoveringForwarder:
             if use_grpc:
                 forwarder_factory = lambda dest: GrpcForwarder(  # noqa: E731
                     dest, timeout_s=timeout_s,
-                    egress_policy=egress_policy)
+                    egress_policy=egress_policy,
+                    engine_stamp=engine_stamp)
             else:
                 # same body-size knob the direct-address path honors
                 forwarder_factory = lambda dest: HttpJsonForwarder(  # noqa: E731
                     dest, timeout_s=timeout_s,
                     max_per_body=max_per_body,
-                    egress_policy=egress_policy)
+                    egress_policy=egress_policy,
+                    engine_stamp=engine_stamp)
         self.factory = forwarder_factory
         self._dests: list[str] = []
         self._fwds: dict = {}

@@ -255,7 +255,9 @@ class ForwardHandler(grpc.GenericRpcHandler):
 
     def __init__(self, submit, ledger: DedupeLedger | None = None,
                  registry: ResilienceRegistry | None = None,
-                 observer=None, submit_batch=None):
+                 observer=None, submit_batch=None,
+                 engine_stamp: str | None = None, note_stamp=None,
+                 merge_sketches=None):
         """`submit(worker_index_hash, ImportedMetric)` routes one metric;
         the Server provides a queue-backed implementation. `ledger`
         (optional) dedupes envelope-bearing requests. `observer`
@@ -268,12 +270,25 @@ class ForwardHandler(grpc.GenericRpcHandler):
         metrics as a unit — the durable path: the Server's
         implementation write-aheads the batch to the engine journal
         BEFORE any worker queue sees it, so an admitted-and-acked
-        interval survives a receiver crash."""
+        interval survives a receiver crash.
+
+        `engine_stamp` (the server's sketch-engine/wire stamp, ISSUE
+        10): requests whose declared stamp — or implied legacy
+        default, for unstamped peers — does not match are ABORTED
+        with FAILED_PRECONDITION before any metric reaches a queue;
+        incompatible register banks must never merge silently.
+        `note_stamp(sender, stamp, ok)` records every verdict
+        (counted + per-sender /debug/fleet rows); `merge_sketches`
+        receives a request's advisory per-prefix cardinality rows
+        (the fleet-wide cardinality satellite)."""
         self._submit = submit
         self._submit_batch = submit_batch
         self._ledger = ledger
         self._registry = registry or DEFAULT_REGISTRY
         self._observer = observer
+        self._engine_stamp = engine_stamp
+        self._note_stamp = note_stamp
+        self._merge_sketches = merge_sketches
 
     def service(self, details):
         from .forward import SEND_METRICS, SEND_METRICS_V2
@@ -330,6 +345,29 @@ class ForwardHandler(grpc.GenericRpcHandler):
         self._submit_batch(pairs, env)
         return len(pairs)
 
+    def _check_stamp(self, remote, env) -> bool:
+        """Engine-stamp verdict for one request; on False the verdict
+        has already been counted/recorded and the caller must abort
+        without applying anything."""
+        if self._engine_stamp is None:
+            return True      # handler built without an engine context
+        from .. import sketches
+        ok = sketches.stamp_compatible(self._engine_stamp, remote)
+        if not ok:
+            # mismatches record + count HERE (the sender is alive and
+            # misconfigured — the fleet page must show it); ACCEPTED
+            # stamps only annotate via the observer scope, after the
+            # normal admission path proves the request decodable
+            if self._note_stamp is not None:
+                self._note_stamp(env[0] if env else "(unknown)",
+                                 remote, False)
+            else:
+                self._registry.incr("import", "import.engine_mismatch")
+            log.warning(
+                "rejected forward with incompatible sketch engines: "
+                "remote %r, local %r", remote, self._engine_stamp)
+        return ok
+
     def _admit(self, env) -> bool:
         if env is None or self._ledger is None:
             return True
@@ -351,12 +389,19 @@ class ForwardHandler(grpc.GenericRpcHandler):
     def _send_metrics(self, request, context):
         env = wire.envelope_from_metric_list(request)
         trace = wire.trace_from_metric_list(request)
+        remote = wire.sketch_stamp_from_metric_list(request)
+        if not self._check_stamp(remote, env):
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "sketch engine/wire-format mismatch")
+        if self._merge_sketches is not None and request.prefix_sketches:
+            self._merge_sketches(wire.prefix_sketches_from_pb(request))
         obs = self._observer
         if obs is None:
             if self._admit(env):
                 self._route_all(request.metrics, env)
             return forward_pb2.Empty()
-        with obs.request(env, trace, "grpc") as scope:
+        kw = {} if self._engine_stamp is None else {"stamp": remote}
+        with obs.request(env, trace, "grpc", **kw) as scope:
             self._apply(scope, env, request.metrics)
         return forward_pb2.Empty()
 
@@ -365,12 +410,17 @@ class ForwardHandler(grpc.GenericRpcHandler):
         md = md() if callable(md) else None
         env = wire.envelope_from_metadata(md)
         trace = wire.trace_from_metadata(md)
+        remote = wire.sketch_stamp_from_metadata(md)
+        if not self._check_stamp(remote, env):
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "sketch engine/wire-format mismatch")
         obs = self._observer
+        kw = {} if self._engine_stamp is None else {"stamp": remote}
         if env is None or self._ledger is None:
             if obs is None:
                 self._route_all(request_iterator)
                 return forward_pb2.Empty()
-            with obs.request(env, trace, "grpc-stream") as scope:
+            with obs.request(env, trace, "grpc-stream", **kw) as scope:
                 scope.admitted = True
                 ph = scope.start("apply")
                 n = self._route_all(request_iterator)
@@ -389,7 +439,7 @@ class ForwardHandler(grpc.GenericRpcHandler):
             if self._ledger.admit(*env):
                 self._route_all(metrics, env)
             return forward_pb2.Empty()
-        with obs.request(env, trace, "grpc-stream") as scope:
+        with obs.request(env, trace, "grpc-stream", **kw) as scope:
             self._apply(scope, env, metrics)
         return forward_pb2.Empty()
 
@@ -397,13 +447,18 @@ class ForwardHandler(grpc.GenericRpcHandler):
 def start_import_server(address: str, submit, max_workers: int = 8,
                         ledger: DedupeLedger | None = None,
                         registry: ResilienceRegistry | None = None,
-                        observer=None, submit_batch=None):
+                        observer=None, submit_batch=None,
+                        engine_stamp: str | None = None,
+                        note_stamp=None, merge_sketches=None):
     """Bind a gRPC server for the Forward service; returns (server, port)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
         (ForwardHandler(submit, ledger=ledger, registry=registry,
-                        observer=observer, submit_batch=submit_batch),))
+                        observer=observer, submit_batch=submit_batch,
+                        engine_stamp=engine_stamp,
+                        note_stamp=note_stamp,
+                        merge_sketches=merge_sketches),))
     port = server.add_insecure_port(address)
     server.start()
     log.info("importsrv listening on %s", address)

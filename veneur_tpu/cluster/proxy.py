@@ -135,6 +135,13 @@ class ProxyServer:
         its own share on the original (sender, seq, chunk) ids."""
         envelope = (metric_list.envelope
                     if metric_list.HasField("envelope") else None)
+        # sketch-engine stamp + advisory prefix sketches pass through
+        # verbatim to EVERY destination's share (stripping the stamp
+        # would make a non-default fleet read as legacy and be refused
+        # at the globals; the cardinality rows merge by max, so every
+        # destination receiving them is idempotent)
+        stamp = metric_list.sketch_engines or None
+        sketches_rows = wire.prefix_sketches_from_pb(metric_list)
         groups = self.route_metrics(metric_list.metrics)
         errs: list[Exception] = []
         threads = []
@@ -142,11 +149,15 @@ class ProxyServer:
             def send(dest=dest, ms=ms):
                 try:
                     fw = self._forwarder_for(dest)
+                    kw = {}
+                    if stamp or sketches_rows:
+                        kw = {"sketch_engines": stamp,
+                              "prefix_sketches": sketches_rows}
                     if envelope is not None and \
                             accepts_envelope(fw.send_metrics):
-                        fw.send_metrics(ms, envelope=envelope)
+                        fw.send_metrics(ms, envelope=envelope, **kw)
                     else:
-                        fw.send_metrics(ms)
+                        fw.send_metrics(ms, **kw)
                 except Exception as e:
                     log.warning("proxy forward to %s failed: %s", dest, e)
                     errs.append(e)
@@ -357,7 +368,13 @@ class HttpProxyFront:
                     wire.ENVELOPE_SEQ_HEADER,
                     wire.ENVELOPE_CHUNK_HEADER,
                     wire.TRACE_HEADER,
-                    wire.TRACE_CLOSE_HEADER)
+                    wire.TRACE_CLOSE_HEADER,
+                    # engine stamp + advisory cardinality rows ride
+                    # verbatim too — a stamp-stripping proxy would
+                    # make a non-default fleet read as legacy and be
+                    # refused at the globals
+                    wire.SKETCH_HEADER,
+                    wire.PREFIX_SKETCH_HEADER)
                     if self.headers.get(h) is not None}
                 errs = front.handle_batch(dicts, envelope=env or None)
                 self.send_response(502 if errs else 200)

@@ -7,14 +7,90 @@ samplers/samplers.go, worker.go (sym: Worker.ImportMetricGRPC).
 
 from __future__ import annotations
 
+import base64
+import json
+
 import numpy as np
 
+from .. import sketches
 from ..ingest.parser import (GLOBAL_ONLY, LOCAL_ONLY, MIXED_SCOPE,
                              MetricKey)
 from ..models.pipeline import ForwardExport
 from .protos import forward_pb2, metric_pb2
 
 HLL_VERSION = 1
+
+# ---- sketch-engine/wire-format stamp (ISSUE 10 mixed-fleet safety) --
+#
+# Every forward request declares which sketch engines produced its
+# payloads: "h=<engine>/<wire_ver>,s=<engine>/<wire_ver>" (strings
+# minted by sketches.engine_stamp). Carriers: MetricList.sketch_engines
+# (field 4) on the forwardrpc arm, the metadata key below on the
+# SendMetricsV2 stream, and the header below on jsonmetric-v1. An
+# ABSENT stamp means a legacy peer running the default pair; a PRESENT
+# stamp that does not match the receiver's engines is rejected loudly
+# (counted + per-sender at /debug/fleet) — incompatible register banks
+# must never merge silently. Like the envelope/trace codecs, the
+# field<->header mapping lives ONLY here (TR01 precedent); the stamp
+# string format itself lives in sketches/ (SK01).
+
+SKETCH_HEADER = "X-Veneur-Sketch-Engines"
+SKETCH_METADATA_KEY = "veneur-sketch-engines"
+
+# per-prefix Huffman-Bucket cardinality sketches riding to the global
+# tier (overload-defense satellite): MetricList.prefix_sketches rows on
+# the forwardrpc arm, one base64(json) header on jsonmetric-v1 (capped
+# by the SENDER to its top prefixes — headers have practical size
+# limits; the pb arm carries the full set)
+PREFIX_SKETCH_HEADER = "X-Veneur-Prefix-Sketches"
+
+
+def sketch_stamp_from_headers(headers) -> str | None:
+    v = _header_get(headers, SKETCH_HEADER)
+    return str(v) if v else None
+
+
+def sketch_stamp_from_metric_list(ml) -> str | None:
+    return ml.sketch_engines or None
+
+
+def sketch_stamp_from_metadata(metadata) -> str | None:
+    for key, value in metadata or ():
+        if key == SKETCH_METADATA_KEY:
+            v = value.decode() if isinstance(value, bytes) else value
+            return v or None
+    return None
+
+
+def encode_prefix_sketches_header(items) -> str:
+    """[(prefix, registers bytes)] -> one base64(json) header value."""
+    payload = [[p, base64.b64encode(bytes(r)).decode("ascii")]
+               for p, r in items]
+    return base64.b64encode(
+        json.dumps(payload, separators=(",", ":")).encode()).decode(
+        "ascii")
+
+
+def decode_prefix_sketches_header(value) -> list:
+    """Inverse of encode_prefix_sketches_header; tolerant — a malformed
+    advisory header decodes to [] (cardinality telemetry must never
+    cost an interval), like the trace-context decoders."""
+    try:
+        payload = json.loads(base64.b64decode(value))
+        return [(str(p), base64.b64decode(r)) for p, r in payload]
+    except Exception:
+        return []
+
+
+def prefix_sketches_to_pb(ml, items) -> None:
+    """Attach [(prefix, registers bytes)] rows to a MetricList."""
+    for p, r in items:
+        ml.prefix_sketches.add(prefix=str(p), registers=bytes(r))
+
+
+def prefix_sketches_from_pb(ml) -> list:
+    return [(ps.prefix, bytes(ps.registers))
+            for ps in ml.prefix_sketches]
 
 # ---- idempotency envelope (exactly-once forward) ----
 #
@@ -180,24 +256,28 @@ _PB_TO_TYPE[metric_pb2.Timer] = "timer"
 
 
 def encode_hll(registers: np.ndarray) -> bytes:
-    regs = np.asarray(registers, np.uint8)
-    precision = int(np.log2(len(regs)))
-    # vlint: disable=DR02 reason=the versioned HLL WIRE row (u8
-    # registers are exact either way); the engine journal reuses this
-    # codec via the MetricList path rather than re-spelling it
-    return bytes([HLL_VERSION, precision]) + regs.tobytes()
+    """The HLL register wire row (code byte 1 — unchanged since the
+    pre-registry tree). The engine-tagged codec lives in sketches/;
+    this name is kept for the HLL arm's callers and golden tests."""
+    return sketches.encode_set_registers("hll", registers)
 
 
 def decode_hll(data: bytes) -> np.ndarray:
-    if len(data) < 2 or data[0] != HLL_VERSION:
+    engine_id, regs = sketches.decode_set_registers(data)
+    if engine_id != "hll":
         raise ValueError("bad HLL payload")
-    precision = data[1]
-    # vlint: disable=DR02 reason=inverse of the HLL wire row above —
-    # same single-homed wire codec, not a bank-leaf byte move
-    regs = np.frombuffer(data[2:], np.uint8)
-    if len(regs) != 1 << precision:
-        raise ValueError("HLL register count mismatch")
     return regs
+
+
+def encode_set_payload(engine_id: str, registers) -> bytes:
+    """Engine-tagged set-register wire row (byte 0 selects the engine:
+    1 = HLL, 2 = ULL — see sketches.encode_set_registers)."""
+    return sketches.encode_set_registers(engine_id, registers)
+
+
+def decode_set_payload(data: bytes) -> tuple:
+    """-> (engine_id, registers u8[m]); ValueError on unknown codes."""
+    return sketches.decode_set_registers(data)
 
 
 def export_to_metrics(export: ForwardExport) -> list:
@@ -220,7 +300,7 @@ def export_to_metrics(export: ForwardExport) -> list:
         m = metric_pb2.Metric(name=key.name,
                               tags=_split_tags(key.joined_tags),
                               type=metric_pb2.Set, scope=metric_pb2.Global)
-        m.set.hyper_log_log = encode_hll(regs)
+        m.set.hyper_log_log = encode_set_payload(export.set_engine, regs)
         out.append(m)
     for key, value in export.counters:
         m = metric_pb2.Metric(name=key.name,
@@ -259,7 +339,9 @@ def export_from_metrics(metrics) -> ForwardExport:
                 (key, means, weights, td.min, td.max, td.sum, td.count,
                  td.reciprocal_sum))
         elif which == "set":
-            export.sets.append((key, decode_hll(m.set.hyper_log_log)))
+            eng_id, regs = decode_set_payload(m.set.hyper_log_log)
+            export.sets.append((key, regs))
+            export.set_engine = eng_id
         elif which == "counter":
             export.counters.append((key, float(m.counter.value)))
         elif which == "gauge":
@@ -284,7 +366,8 @@ def apply_metric_to_engine(engine, m) -> None:
         engine.import_histogram(key, means, weights, td.min, td.max,
                                 td.sum, td.count, td.reciprocal_sum)
     elif which == "set":
-        engine.import_set(key, decode_hll(m.set.hyper_log_log))
+        eng_id, regs = decode_set_payload(m.set.hyper_log_log)
+        engine.import_set(key, regs, eng_id)
     elif which == "counter":
         engine.import_counter(key, float(m.counter.value))
     elif which == "gauge":
@@ -307,7 +390,8 @@ def apply_metric_to_engine_locked(engine, m) -> None:
             key, means, weights, td.min, td.max, td.sum, td.count,
             td.reciprocal_sum)
     elif which == "set":
-        engine._import_set_locked(key, decode_hll(m.set.hyper_log_log))
+        eng_id, regs = decode_set_payload(m.set.hyper_log_log)
+        engine._import_set_locked(key, regs, eng_id)
     elif which == "counter":
         engine._import_counter_locked(key, float(m.counter.value))
     elif which == "gauge":

@@ -261,6 +261,26 @@ class Config:
 
     # --- TPU engine (new; the north star's aggregation_backend key) ---
     aggregation_backend: str = "tpu"   # "tpu" | "cpu" (forces jax cpu)
+    # Sketch-engine selection (veneur_tpu/sketches/, ISSUE 10).
+    # histogram_backend: "tdigest" (default; absolute-rank k1 digest,
+    # tight mid-range percentiles) | "req" (relative-error adaptive
+    # compactors: ~1% p99.9 value error on heavy-tailed streams where
+    # t-digest clusters blur the tail; mid-range is distribution-
+    # dependent — see README "Sketch engines").
+    # set_backend: "hll" (default; LogLog-Beta, 2^p u8 registers) |
+    # "ull" (UltraLogLog, arxiv 2308.16862: ~half the register bytes
+    # at equal nominal error via an ML estimator).
+    # BOTH ends of a forwarding pair must run the SAME engines: every
+    # forward request carries an engine/wire stamp and a mismatched
+    # receiver rejects loudly (veneur.import.engine_mismatch_total,
+    # per-sender at /debug/fleet) instead of merging incompatible
+    # sketches. Not supported with native_ingest or tpu_num_devices>1
+    # (those own their banks).
+    histogram_backend: str = "tdigest"
+    set_backend: str = "hll"
+    tpu_ull_precision: int = 13        # ULL registers = 2^p bytes/slot
+    tpu_req_levels: int = 2            # REQ compactor levels
+    tpu_req_capacity: int = 256        # items per level per slot
     tpu_histogram_slots: int = 1 << 15
     tpu_counter_slots: int = 1 << 14
     tpu_gauge_slots: int = 1 << 14
@@ -458,6 +478,31 @@ def _validate(cfg: Config) -> None:
         raise ValueError("tpu_buffer_depth must be >= 8")
     if not (4 <= cfg.tpu_hll_precision <= 16):
         raise ValueError("tpu_hll_precision must be in [4, 16]")
+    if cfg.histogram_backend not in ("tdigest", "req"):
+        raise ValueError(
+            f"histogram_backend must be tdigest or req, got "
+            f"{cfg.histogram_backend!r}")
+    if cfg.set_backend not in ("hll", "ull"):
+        raise ValueError(
+            f"set_backend must be hll or ull, got {cfg.set_backend!r}")
+    if not (4 <= cfg.tpu_ull_precision <= 16):
+        raise ValueError("tpu_ull_precision must be in [4, 16]")
+    if cfg.tpu_req_levels < 1 or cfg.tpu_req_capacity < 32 \
+            or cfg.tpu_req_capacity % 8:
+        raise ValueError(
+            "tpu_req_levels must be >= 1 and tpu_req_capacity a "
+            "multiple of 8 >= 32 (the compactor's protect/trigger "
+            "sections need the room)")
+    if (cfg.histogram_backend != "tdigest"
+            or cfg.set_backend != "hll"):
+        if cfg.native_ingest:
+            raise ValueError(
+                "non-default sketch backends are not supported with "
+                "native_ingest (the C++ bridge computes HLL updates)")
+        if cfg.tpu_num_devices > 1:
+            raise ValueError(
+                "non-default sketch backends are not supported with "
+                "tpu_num_devices > 1 (the mesh engine owns its banks)")
     if cfg.tpu_flush_fetch_f16 and cfg.tpu_num_devices > 1:
         raise ValueError(
             "tpu_flush_fetch_f16 is not supported with tpu_num_devices > 1 "

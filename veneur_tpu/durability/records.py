@@ -220,7 +220,11 @@ def encode_spill_state(spill) -> bytes:
     dicts ride as one export payload (same wire codecs), gauge ages and
     the remembered merge ages ride as side lists keyed by position/key.
     """
-    export = ForwardExport()
+    export = ForwardExport(
+        # the engine tag rides the wire row (byte 0): without it a ULL
+        # server's spilled registers would journal under the HLL code
+        # and silently max-join after a cross-engine restore
+        set_engine=getattr(spill, "set_engine", "hll"))
     export.histograms.extend(
         (key, h[0], h[1], h[2], h[3], h[4], h[5], h[6])
         for key, h in spill._histos.items())
@@ -246,6 +250,11 @@ def decode_spill_state(data: bytes, spill) -> None:
 
     from ..ingest.parser import MetricKey
     export, off = decode_export(data, 0)
+    # restore the engine the registers were tagged with, so later
+    # same-key spills join under the right semantics and re-forwards
+    # carry the original code (a backend-switched restart then fails
+    # LOUDLY at the receiver's belt check, never silently merges)
+    spill.set_engine = export.set_engine
     for key, means, weights, vmin, vmax, vsum, cnt, recip in (
             export.histograms):
         spill._histos[key] = [np.asarray(means, np.float32),
@@ -317,14 +326,46 @@ _DTYPE_CODES = {0: np.float32, 1: np.int32, 2: np.uint8, 3: np.int64}
 _CODE_OF_DTYPE = {np.dtype(v): k for k, v in _DTYPE_CODES.items()}
 
 
+def _engine_descs(cfg) -> tuple | None:
+    """Engine-identity strings appended to the fingerprint when the
+    config selects NON-DEFAULT sketch backends (ISSUE 10): a restore
+    into a different backend — or the same backend at different
+    accuracy params — must refuse loudly, not scatter one engine's
+    leaf bytes into another's banks. None for the default pair, so
+    default-engine fingerprints (and every pre-registry journal) keep
+    their original 8-tuple shape byte-for-byte."""
+    hb = getattr(cfg, "histogram_backend", "tdigest")
+    sb = getattr(cfg, "set_backend", "hll")
+    if hb == "tdigest" and sb == "hll":
+        return None
+    if hb == "tdigest":
+        hd = "tdigest/1"
+    else:
+        hd = (f"req/1:levels={int(getattr(cfg, 'req_levels', 2))},"
+              f"capacity={int(getattr(cfg, 'req_capacity', 256))}")
+    if sb == "hll":
+        sd = "hll/1"
+    else:
+        sd = f"ull/1:p={int(getattr(cfg, 'ull_precision', 13))}"
+    return hd, sd
+
+
 def engine_fingerprint(cfg, num_centroids: int) -> tuple:
     """The shape identity a checkpoint was taken under. A restore into
     an engine with a different fingerprint must refuse whole (rows would
-    scatter into the wrong slots / wrong widths)."""
-    return (int(cfg.histogram_slots), int(num_centroids),
+    scatter into the wrong slots / wrong widths). With non-default
+    sketch backends the tuple grows two engine-identity strings (see
+    _engine_descs) — a checkpoint written under `ull`+`req` can never
+    silently restore into a default-engine server or vice versa."""
+    sb = getattr(cfg, "set_backend", "hll")
+    m = (1 << int(getattr(cfg, "ull_precision", 13))) if sb == "ull" \
+        else (1 << int(cfg.hll_precision))
+    base = (int(cfg.histogram_slots), int(num_centroids),
             int(cfg.buffer_depth), int(cfg.counter_slots),
             int(cfg.gauge_slots), int(cfg.set_slots),
-            1 << int(cfg.hll_precision), float(cfg.compression))
+            m, float(cfg.compression))
+    descs = _engine_descs(cfg)
+    return base if descs is None else base + descs
 
 
 def encode_engine_import(op_id: int, metrics, envelope=None) -> bytes:
@@ -374,14 +415,24 @@ def decode_engine_import(data: bytes):
 
 def encode_engine_meta(engine_idx: int, n_engines: int, watermark: int,
                        gauge_seq: int, fingerprint: tuple) -> bytes:
-    return _ENG_META.pack(engine_idx, n_engines, watermark,
-                          int(gauge_seq)) + _ENG_FPR.pack(*fingerprint)
+    out = _ENG_META.pack(engine_idx, n_engines, watermark,
+                         int(gauge_seq)) \
+        + _ENG_FPR.pack(*fingerprint[:8])
+    # non-default backends append their engine-identity strings; the
+    # default pair stays byte-identical to the pre-registry record
+    for desc in fingerprint[8:]:
+        out += _pack_str(str(desc))
+    return out
 
 
 def decode_engine_meta(data: bytes):
     engine_idx, n_engines, watermark, gauge_seq = \
         _ENG_META.unpack_from(data, 0)
-    fpr = _ENG_FPR.unpack_from(data, _ENG_META.size)
+    fpr = list(_ENG_FPR.unpack_from(data, _ENG_META.size))
+    off = _ENG_META.size + _ENG_FPR.size
+    while off < len(data):
+        desc, off = _unpack_str(data, off)
+        fpr.append(desc)
     return engine_idx, n_engines, watermark, gauge_seq, tuple(fpr)
 
 
@@ -434,24 +485,32 @@ def _decode_leaf(data: bytes, off: int, n_rows: int):
 
 
 def encode_engine_bank(engine_idx: int, bank_kind: int,
-                       slot_ids: np.ndarray, leaves: dict) -> bytes:
+                       slot_ids: np.ndarray, leaves: dict,
+                       leaf_names: tuple | None = None) -> bytes:
     """One bank's dirty rows: slot ids + every leaf's rows at those
-    ids, in the fixed BANK_LEAVES order, as raw little-endian bytes."""
+    ids, in a fixed leaf order, as raw little-endian bytes.
+    `leaf_names` defaults to the default engines' BANK_LEAVES order;
+    non-default sketch backends pass their own (the fingerprint's
+    engine descs guarantee encode and decode agree on it)."""
     slot_ids = np.ascontiguousarray(slot_ids, np.int32)
     out = [_ENG_BANK_HEAD.pack(engine_idx, bank_kind, len(slot_ids)),
            slot_ids.tobytes()]
-    for name in BANK_LEAVES[bank_kind]:
+    for name in (leaf_names or BANK_LEAVES[bank_kind]):
         out.append(_encode_leaf(leaves[name]))
     return b"".join(out)
 
 
-def decode_engine_bank(data: bytes):
+def decode_engine_bank(data: bytes, leaf_names_of=None):
+    """`leaf_names_of(bank_kind)` (optional) supplies the decode-side
+    leaf order for non-default engines; default is BANK_LEAVES."""
     engine_idx, bank_kind, n = _ENG_BANK_HEAD.unpack_from(data, 0)
     off = _ENG_BANK_HEAD.size
     slot_ids = np.frombuffer(data, np.int32, n, off).copy()
     off += n * 4
+    names = (leaf_names_of(bank_kind) if leaf_names_of is not None
+             else BANK_LEAVES[bank_kind])
     leaves = {}
-    for name in BANK_LEAVES[bank_kind]:
+    for name in names:
         leaves[name], off = _decode_leaf(data, off, n)
     return engine_idx, bank_kind, slot_ids, leaves
 
@@ -538,11 +597,13 @@ def encode_engine_checkpoint(engine_idx: int, n_engines: int,
     for kind, (interval, entries) in snap["interner"].items():
         recs.append((REC_ENGINE_KEYS, encode_engine_keys(
             engine_idx, kind, interval, entries)))
+    leaf_names = snap.get("leaf_names", {})
     for kind, (slot_ids, leaves) in snap["banks"].items():
         if len(slot_ids) == 0:
             continue              # fresh rows need no record
         recs.append((REC_ENGINE_BANK, encode_engine_bank(
-            engine_idx, kind, slot_ids, leaves)))
+            engine_idx, kind, slot_ids, leaves,
+            leaf_names=leaf_names.get(kind))))
     staged = snap["staged"]
     if any(staged.get(f) for f in ("centroids", "sets", "counters",
                                    "gauges")):

@@ -79,7 +79,8 @@ class HttpApi:
     def __init__(self, address: str, submit=None, healthy=None,
                  ledger=None, debug_state=None, profile=None,
                  observer=None, fleet_state=None, health=None,
-                 submit_batch=None):
+                 submit_batch=None, engine_stamp=None, note_stamp=None,
+                 merge_sketches=None):
         """`debug_state()` (optional) returns the JSON-ready dict for
         GET /debug/flush; `profile(ticks)` (optional) schedules an
         on-demand jax.profiler capture — absent means the knob is off
@@ -101,7 +102,15 @@ class HttpApi:
         routes one request's decoded metrics as a unit — the Server's
         durable implementation write-aheads the batch to the engine
         journal before any worker queue (and therefore before the 200
-        ack) sees it."""
+        ack) sees it.
+
+        `engine_stamp` (ISSUE 10): the server's sketch-engine/wire
+        stamp; a POST /import whose declared stamp (or implied legacy
+        default) does not match is 400'd BEFORE any decode work —
+        incompatible sketch payloads must never merge. Verdicts are
+        recorded via `note_stamp(sender, stamp, ok)`; advisory
+        per-prefix cardinality rows (X-Veneur-Prefix-Sketches) feed
+        `merge_sketches(items)`."""
         host, _, port = address.rpartition(":")
         host = host.strip("[]") or "0.0.0.0"
         self._submit = submit
@@ -113,6 +122,9 @@ class HttpApi:
         self._observer = observer
         self._fleet_state = fleet_state
         self._health = health
+        self._engine_stamp = engine_stamp
+        self._note_stamp = note_stamp
+        self._merge_sketches = merge_sketches
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -231,12 +243,40 @@ class HttpApi:
                     self._reply(400, f"bad forward envelope: "
                                      f"{e}\n".encode())
                     return
+                # sketch-engine/wire stamp (ISSUE 10): a mismatched
+                # fleet degrades LOUDLY — 400 before any decode work,
+                # verdict counted + recorded per sender
+                obs_kw = {}
+                if api._engine_stamp is not None:
+                    from . import sketches
+                    remote = wire.sketch_stamp_from_headers(self.headers)
+                    ok = sketches.stamp_compatible(api._engine_stamp,
+                                                   remote)
+                    if not ok:
+                        # mismatch: counted + the sender's row marked
+                        # (it IS alive, just misconfigured); accepted
+                        # stamps annotate via the observer scope only
+                        # after the body proves decodable
+                        if api._note_stamp is not None:
+                            api._note_stamp(
+                                env[0] if env else "(unknown)",
+                                remote, False)
+                        self._reply(400, b"sketch engine/wire-format "
+                                         b"mismatch\n")
+                        return
+                    obs_kw["stamp"] = remote
+                if api._merge_sketches is not None:
+                    raw = self.headers.get(wire.PREFIX_SKETCH_HEADER)
+                    if raw:
+                        items = wire.decode_prefix_sketches_header(raw)
+                        if items:
+                            api._merge_sketches(items)
                 if api._observer is not None:
                     # tolerant trace decode (None on malformed) + the
                     # import ring / span-tree / fleet observation scope
                     trace = wire.trace_from_headers(self.headers)
-                    with api._observer.request(env, trace,
-                                               "http") as scope:
+                    with api._observer.request(env, trace, "http",
+                                               **obs_kw) as scope:
                         self._import_body(env, scope)
                 else:
                     self._import_body(env, None)

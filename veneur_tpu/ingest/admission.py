@@ -387,6 +387,18 @@ class AdmissionController:
 
     # ------------- introspection -------------
 
+    def export_sketches(self) -> list:
+        """[(prefix, bytes registers)] snapshots of the per-prefix
+        cardinality sketches — the forward-wire rows the global tier
+        merges by max (fleet-wide cardinality, ISSUE 10 satellite).
+        Cheap: one bytes() copy per prefix under the lock."""
+        with self._lock:
+            out = [(p, bytes(st.sketch.regs))
+                   for p, st in self._prefixes.items()]
+            if any(self._overflow.sketch.regs):
+                out.append((self._suffix, bytes(self._overflow.sketch.regs)))
+            return out
+
     def prefix_count(self) -> int:
         with self._lock:
             return len(self._prefixes)

@@ -37,10 +37,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import sketches
 from ..ingest.parser import (
     GLOBAL_ONLY, LOCAL_ONLY, MetricKey, UDPMetric)
 from ..metrics import InterMetric, MetricFrame, MetricType
-from ..ops import hll, scalar, tdigest
+from ..ops import scalar
 from ..utils import hashing
 from .worker import FOLD_SLOT, KeyInterner
 
@@ -110,25 +111,26 @@ def _precluster_k1(v, w, n_points, keep_extremes=False):
 # and one compile.
 
 @functools.lru_cache(maxsize=None)
-def _fresh_banks_executable(device, histogram_slots, compression,
-                            buffer_depth, counter_slots, gauge_slots,
-                            set_slots, hll_precision):
+def _fresh_banks_executable(device, heng, seng, histogram_slots,
+                            counter_slots, gauge_slots, set_slots):
     """One jitted program materializing a full set of fresh interval banks
     on `device` — the Worker.Flush map-swap costs one dispatch, not ~15
-    host-built zero arrays."""
+    host-built zero arrays. `heng`/`seng` are the selected sketch
+    engines (frozen dataclasses — hashable cache keys carrying the
+    static shape params)."""
     sds = jax.sharding.SingleDeviceSharding(device)
 
     def make():
-        return (tdigest.init(histogram_slots, compression, buffer_depth),
+        return (heng.init(histogram_slots),
                 scalar.init_counters(counter_slots),
                 scalar.init_gauges(gauge_slots),
-                hll.init(set_slots, hll_precision))
+                seng.init(set_slots))
 
     return jax.jit(make, out_shardings=sds)
 
 
 @functools.lru_cache(maxsize=None)
-def _ingest_executables(device, compression):
+def _ingest_executables(device, heng, seng):
     """Committed-output builds of the four ingest scatter kernels.
 
     The module-level ops (tdigest.add_batch & co) are plain jits: their
@@ -136,32 +138,26 @@ def _ingest_executables(device, compression):
     uncommitted is the ~1000x-slow variant on the tunneled TPU backend —
     which would put every ingest batch AND the following flush on the
     slow path. Pinning out_shardings keeps the whole bank lineage
-    committed from _fresh_banks onward."""
+    committed from _fresh_banks onward. Every sketch op routes through
+    the engine objects — the registry boundary (vlint SK01)."""
     sds = jax.sharding.SingleDeviceSharding(device)
-
-    def add_histos(bank, slots, values, weights):
-        return tdigest._add_batch_impl(bank, slots, values, weights,
-                                       compression)
-
-    def compress(bank):
-        return tdigest._compress_impl(bank, compression)
 
     jit = functools.partial(jax.jit, donate_argnums=(0,),
                             out_shardings=sds)
     return {
-        "histo": jit(add_histos),
+        "histo": jit(heng.add_batch_impl),
         "counter": jit(scalar.counter_add.__wrapped__),
         "gauge": jit(scalar.gauge_set.__wrapped__),
-        "set": jit(hll.insert.__wrapped__),
+        "set": jit(seng.insert_impl),
         # hot-slot sidestep programs (see _add_histo_batch)
-        "compress": jit(compress),
-        "merge_centroids": jit(tdigest.merge_centroids.__wrapped__),
-        "merge_scalars": jit(tdigest.merge_scalars.__wrapped__),
+        "compress": jit(heng.compress_impl),
+        "merge_centroids": jit(heng.merge_centroids_impl),
+        "merge_scalars": jit(heng.merge_scalars_impl),
     }
 
 
 @functools.lru_cache(maxsize=None)
-def _flush_executable(device, compression, fwd_out, agg_emit, pallas_ok,
+def _flush_executable(device, heng, seng, fwd_out, agg_emit, pallas_ok,
                       donate=True, compact=False):
     """The fused interval-flush program: compress + quantiles + the
     configured aggregates + counter/gauge/set finalization in ONE XLA
@@ -199,14 +195,17 @@ def _flush_executable(device, compression, fwd_out, agg_emit, pallas_ok,
     sds = jax.sharding.SingleDeviceSharding(device)
 
     def program(hb, cb, gb, sb, qs):
-        hb = tdigest._compress_impl(hb, compression)
-        agg = tdigest.aggregates(hb)
-        q = tdigest.quantile(hb, qs)
+        hb = heng.compress_impl(hb)
+        agg = heng.aggregates_impl(hb)
+        q = heng.quantile_impl(hb, qs)
         out = {
             "c_hi": cb.hi, "c_lo": cb.lo,
             "g_value": gb.value, "g_seq": gb.seq,
-            "s_est": hll.estimate(sb, force_jnp=not pallas_ok),
         }
+        # set estimate: HLL emits the finished per-slot estimate; ULL
+        # emits its device-side sufficient statistic and the host half
+        # of estimate (estimate_finalize) finishes it after the fetch
+        out.update(seng.estimate_device(sb, pallas_ok))
         cols, hp_cols, lp_cols, lo_terms = [], [], [], []
         for a in agg_emit:
             if a == "count":
@@ -254,13 +253,8 @@ def _flush_executable(device, compression, fwd_out, agg_emit, pallas_ok,
         if "count" not in agg_emit:
             out["cnt"] = agg["count"]
         if fwd_out:
-            out.update(
-                h_mean=hb.mean, h_weight=hb.weight,
-                h_min=hb.vmin, h_max=hb.vmax,
-                h_sum=hb.vsum, h_sum_lo=hb.vsum_lo,
-                h_count=hb.count, h_count_lo=hb.count_lo,
-                h_recip=hb.recip, h_recip_lo=hb.recip_lo,
-                s_regs=sb.registers)
+            out.update(heng.forward_leaves(hb))
+            out["s_regs"] = sb.registers
         return out
 
     # donate=False builds a variant safe to dispatch repeatedly on the
@@ -278,35 +272,31 @@ def _flush_executable(device, compression, fwd_out, agg_emit, pallas_ok,
         return jax.jit(program, donate_argnums=(1, 2),
                        out_shardings=sds)
 
-    # fwd_out: the histo bank's mean/weight and eight scalar leaves are
-    # echoed verbatim (h_*), as are the HLL registers (s_regs) — real
-    # aliasing worth ~2 x [K, C] f32 of transient memory per flush at
-    # 100k slots. The buffer leaves (buf_value/buf_weight/buf_n) have
-    # no same-shaped output, and donating them alongside would bring
-    # the partial-donation warning back, so the bank is split into a
-    # donated core and an un-donated buffer tuple behind a
-    # signature-preserving wrapper.
+    # fwd_out: the histo bank's item matrices and eight scalar leaves
+    # are echoed verbatim (h_*), as are the set registers (s_regs) —
+    # real aliasing worth ~2 x [K, C] f32 of transient memory per flush
+    # at 100k slots. The engine's donation_split names the leaves with
+    # same-shaped outputs; the rest (sample buffers, level counters)
+    # would bring the partial-donation warning back, so the bank is
+    # split into a donated core and an un-donated remainder behind a
+    # signature-preserving wrapper (engine.reassemble).
+    split = heng.donation_split()
+    if split is None:
+        return jax.jit(program, donate_argnums=(1, 2),
+                       out_shardings=sds)
+    core_names, buf_names = split
+
     def flat(core, bufs, cb, gb, sb, qs):
-        (mean, weight, vmin, vmax, vsum, count, recip,
-         vsum_lo, count_lo, recip_lo) = core
-        # vlint: disable=SR02 reason=reassembling the caller's own bank
-        # from its unmodified leaves — centroid order is untouched
-        hb = tdigest.TDigestBank(
-            mean=mean, weight=weight, buf_value=bufs[0],
-            buf_weight=bufs[1], buf_n=bufs[2], vmin=vmin, vmax=vmax,
-            vsum=vsum, count=count, recip=recip, vsum_lo=vsum_lo,
-            count_lo=count_lo, recip_lo=recip_lo)
+        hb = heng.reassemble(core, bufs)
         return program(hb, cb, gb, sb, qs)
 
     jitted = jax.jit(flat, donate_argnums=(0, 2, 3, 4),
                      out_shardings=sds)
 
     def call(hb, cb, gb, sb, qs):
-        core = (hb.mean, hb.weight, hb.vmin, hb.vmax, hb.vsum,
-                hb.count, hb.recip, hb.vsum_lo, hb.count_lo,
-                hb.recip_lo)
-        return jitted(core, (hb.buf_value, hb.buf_weight, hb.buf_n),
-                      cb, gb, sb, qs)
+        core = tuple(getattr(hb, n) for n in core_names)
+        bufs = tuple(getattr(hb, n) for n in buf_names)
+        return jitted(core, bufs, cb, gb, sb, qs)
 
     return call
 
@@ -424,6 +414,18 @@ class EngineConfig:
     compression: float = 100.0
     buffer_depth: int = 256
     hll_precision: int = 14
+    # Sketch-engine selection (veneur_tpu/sketches/ registry, ISSUE
+    # 10): which sketch implements the histogram/timer banks and the
+    # set-cardinality banks. The defaults are the pre-registry pair
+    # (behavior-identical); "req" = relative-error adaptive-compactor
+    # quantiles (tail-accurate), "ull" = UltraLogLog registers (half
+    # the state at equal nominal error). The per-engine shape knobs
+    # below only apply to their engine.
+    histogram_backend: str = "tdigest"
+    set_backend: str = "hll"
+    ull_precision: int = 13
+    req_levels: int = 2
+    req_capacity: int = 256
     batch_size: int = 8192
     percentiles: tuple = (0.5, 0.75, 0.99)
     aggregates: tuple = ("min", "max", "count")
@@ -465,6 +467,14 @@ class ForwardExport:
     sets: list = dc_field(default_factory=list)        # (key, registers u8[m])
     counters: list = dc_field(default_factory=list)    # (key, value)
     gauges: list = dc_field(default_factory=list)      # (key, value)
+    # which set engine produced `sets` (selects the register wire code
+    # and the spill re-merge join); histograms are engine-agnostic
+    # weighted points on the wire
+    set_engine: str = "hll"
+    # per-prefix Huffman-Bucket cardinality sketches riding to the
+    # global tier (overload-defense satellite): [(prefix, bytes regs)];
+    # merge-by-max, advisory — excluded from the durability journal
+    prefix_sketches: list = dc_field(default_factory=list)
 
 
 class FlushResult:
@@ -530,17 +540,17 @@ class AggregationEngine:
         cfg = self.cfg
         self._device = jax.devices()[0]
         self._fresh_fn = _fresh_banks_executable(
-            self._device, cfg.histogram_slots, cfg.compression,
-            cfg.buffer_depth, cfg.counter_slots, cfg.gauge_slots,
-            cfg.set_slots, cfg.hll_precision)
+            self._device, self._heng, self._seng, cfg.histogram_slots,
+            cfg.counter_slots, cfg.gauge_slots, cfg.set_slots)
         (self.histo_bank, self.counter_bank,
          self.gauge_bank, self.set_bank) = self._fresh_fn()
-        self._kern = _ingest_executables(self._device, cfg.compression)
+        self._kern = _ingest_executables(self._device, self._heng,
+                                         self._seng)
 
     def _setup_flush_exec(self):
         cfg = self.cfg
         self._flush_exec = _flush_executable(
-            self._device, cfg.compression, self._fwd_out,
+            self._device, self._heng, self._seng, self._fwd_out,
             tuple(self._agg_emit),
             self._device.platform in ("tpu", "axon"),
             compact=cfg.flush_fetch_f16)
@@ -580,6 +590,11 @@ class AggregationEngine:
         # immutable snapshot lock-free while ingest continues.
         self.lock = threading.Lock()
         cfg = self.cfg
+        # Selected sketch engines (sketches/ registry): frozen
+        # dataclasses carrying the static shape params; every sketch
+        # call in this module routes through them (vlint SK01).
+        self._heng = sketches.histogram_engine(cfg)
+        self._seng = sketches.set_engine(cfg)
         self._setup_device()
 
         self.histo_keys = KeyInterner(cfg.histogram_slots,
@@ -794,15 +809,12 @@ class AggregationEngine:
                 m, slot = self._fold(self.set_keys, m)
                 if m is None:
                     return
-            # Inline int bit ops (no numpy round-trip) — this is the
-            # per-sample hot path.
-            p = self.cfg.hll_precision
+            # Engine-specific hash decomposition (int bit ops, no
+            # numpy round-trip) — this is the per-sample hot path.
             h = hashing.set_member_hash(str(m.value))
-            idx = h >> (64 - p)
-            rest = ((h << p) & 0xFFFFFFFFFFFFFFFF) | ((1 << p) - 1)
-            rho = 65 - rest.bit_length()  # clz + 1; sentinel caps range
+            idx, val = self._seng.hash_update(h)
             st = self._set_stage
-            st.put(slots=slot, reg_idx=idx, rho=rho)
+            st.put(slots=slot, reg_idx=idx, rho=val)
             if st.full():
                 self._dispatch_sets()
 
@@ -1002,8 +1014,10 @@ class AggregationEngine:
     def _hot_widths(self):
         """Fixed pad shapes for the hot-slot sidestep: at most
         batch/B slots can be hot in one batch, each contributing <= B
-        pre-clustered points."""
-        B = self.cfg.buffer_depth
+        pre-clustered points. B is the BANK's per-landing headroom
+        (the engine's buf_size — t-digest buffer depth, compactor
+        level capacity), which need not equal cfg.buffer_depth."""
+        B = self.histo_bank.buf_size
         n_hot = max(1, self.cfg.batch_size // max(1, B))
         return n_hot * min(B, self.cfg.batch_size), max(1, n_hot)
 
@@ -1032,7 +1046,7 @@ class AggregationEngine:
         # Run the full configured flush path (program + staging/fetch
         # mode) so flush 0 hits only warm executables.
         self._flush_device(self._fresh_fn())
-        jax.block_until_ready(self.histo_bank.mean)
+        jax.block_until_ready(self.histo_bank)
 
     def warm_ingest_kernels(self, b: int):
         """Precompile the batch-ingest kernels at an ADDITIONAL batch
@@ -1051,7 +1065,7 @@ class AggregationEngine:
             self.gauge_bank = self._kern["gauge"](
                 self.gauge_bank, pad, zf, zi)
             self.set_bank = self._kern["set"](self.set_bank, pad, zi, zu)
-        jax.block_until_ready(self.histo_bank.mean)
+        jax.block_until_ready(self.histo_bank)
 
     # ---------------- import (global tier Combine path) ----------------
 
@@ -1081,18 +1095,30 @@ class AggregationEngine:
                 >= _IMPORT_STAGE_CENTROIDS):
             self._flush_import_centroids()
 
-    def import_set(self, key: MetricKey, registers):
+    def import_set(self, key: MetricKey, registers, engine_id=None):
         with self.lock:
-            self._import_set_locked(key, registers)
+            self._import_set_locked(key, registers, engine_id)
 
-    def _import_set_locked(self, key, registers):
+    def _import_set_locked(self, key, registers, engine_id=None):
+        # belt to the request-level stamp check's suspenders: a
+        # register row of the wrong engine or width must reject THIS
+        # metric (the poison-pill counter), never join a bank whose
+        # update rule it does not share
+        if engine_id is not None and engine_id != self._seng.id:
+            raise ValueError(
+                f"set sketch engine mismatch: payload {engine_id!r}, "
+                f"bank runs {self._seng.id!r}")
+        regs = np.asarray(registers, np.uint8)
+        if regs.shape[-1] != self.set_bank.num_registers:
+            raise ValueError(
+                f"set register width {regs.shape[-1]} != bank width "
+                f"{self.set_bank.num_registers}")
         slot = self.set_keys.lookup(key, GLOBAL_ONLY)
         if slot == FOLD_SLOT:
             slot = self._fold_import_slot(self.set_keys, key)
         if slot < 0:
             return
-        self._import_sets.append(
-            (slot, np.asarray(registers, np.uint8)))
+        self._import_sets.append((slot, regs))
         if len(self._import_sets) >= 256:
             self._flush_import_sets()
 
@@ -1154,7 +1180,7 @@ class AggregationEngine:
         slots = np.array([s for s, _ in items], np.int32)
         if self._dirty is not None:
             self._mark_dirty(3, slots)
-        self.set_bank = jax.device_put(hll.merge_rows(
+        self.set_bank = jax.device_put(self._seng.merge_rows(
             self.set_bank, slots,
             np.stack([r for _, r in items])), self._device)
 
@@ -1181,19 +1207,20 @@ class AggregationEngine:
                 self._device)
 
     def _flush_import_centroids(self):
-        """Merge staged foreign digests in O(1) device calls: group the
-        interval's forwarded centroids per slot on host, pre-cluster each
-        slot's pile to <= C centroids with ONE batched cluster_rows
-        program, then land everything with one merge + one compress.
-        (The previous chunk-through-the-sample-buffer scheme cost a
-        compress round-trip per ~B centroids — dozens of dispatches for a
-        32-shard import; this is 3.)"""
+        """Land staged foreign digests under the engine's import
+        strategy: "cluster" (t-digest — precluster each slot's pile to
+        <= C centroids with ONE batched cluster_rows program, then one
+        merge + one compress) or "direct" (compactor engines — the
+        items re-insert as weighted points in fixed-width batches; the
+        engine's own compaction bounds memory, no preclustering)."""
         if not self._import_centroids:
             return
         items = self._import_centroids
         self._import_centroids = []
         self._import_centroid_total = 0
-        comp = self.cfg.compression
+        if self._heng.import_strategy == "direct":
+            self._land_imports_direct(items)
+            return
         C = self.histo_bank.num_centroids
 
         by_slot: dict[int, list] = {}
@@ -1257,10 +1284,9 @@ class AggregationEngine:
             for prefix, (owners, chunks_v, chunks_w) in batches.items():
                 if not owners:
                     continue
-                cm, cw = tdigest.cluster_rows(
+                cm, cw = self._heng.cluster_rows(
                     np.stack(chunks_v), np.stack(chunks_w),
-                    compression=comp, num_centroids=C,
-                    sorted_prefix=prefix)
+                    num_centroids=C, sorted_prefix=prefix)
                 cm, cw = np.asarray(cm), np.asarray(cw)
                 for row, s in enumerate(owners):
                     by_slot[s].append((cm[row], cw[row]))
@@ -1282,8 +1308,8 @@ class AggregationEngine:
                 vals[row, off:off + n] = m
                 wts[row, off:off + n] = w
                 off += n
-        cmeans, cwts = tdigest.cluster_rows(
-            vals, wts, compression=comp, num_centroids=C)
+        cmeans, cwts = self._heng.cluster_rows(
+            vals, wts, num_centroids=C)
         cmeans, cwts = np.asarray(cmeans), np.asarray(cwts)
         # land the clustered centroids; merge_centroids drops on buffer
         # overflow, so chunk the C columns to the buffer depth (one
@@ -1292,17 +1318,15 @@ class AggregationEngine:
         for c0 in range(0, C, B):
             chunk = slice(c0, min(C, c0 + B))
             width = chunk.stop - chunk.start
-            self.histo_bank = tdigest.compress(self.histo_bank,
-                                               compression=comp)
+            self.histo_bank = self._heng.compress(self.histo_bank)
             rows = np.repeat(slot_ids, width)
-            self.histo_bank = tdigest.merge_centroids(
+            self.histo_bank = self._heng.merge_centroids(
                 self.histo_bank, rows, cmeans[:, chunk].reshape(-1),
                 cwts[:, chunk].reshape(-1))
-        self.histo_bank = tdigest.compress(self.histo_bank,
-                                           compression=comp)
+        self.histo_bank = self._heng.compress(self.histo_bank)
 
         sl = np.array([it[0] for it in items], np.int32)
-        self.histo_bank = tdigest.merge_scalars(
+        self.histo_bank = self._heng.merge_scalars(
             self.histo_bank, sl,
             np.array([it[3] for it in items], np.float32),
             np.array([it[4] for it in items], np.float32),
@@ -1312,6 +1336,46 @@ class AggregationEngine:
         # the merge chain above ran through plain jits whose outputs are
         # uncommitted; recommit so the ingest kernels and the flush
         # program stay on their committed (fast) executables
+        self.histo_bank = jax.device_put(self.histo_bank, self._device)
+
+    # fixed flat-batch width for the direct import landing: one program
+    # shape however many centroids an interval staged
+    _DIRECT_LAND_WIDTH = 4096
+
+    def _land_imports_direct(self, items):
+        """The "direct" import strategy (compactor engines): re-insert
+        every forwarded weighted point through the engine's own
+        merge_centroids — its internal compaction bounds memory, so no
+        host-side preclustering pass is needed. Batches are fixed-width
+        (padded, slot -1 dropped) so the program shape never varies."""
+        W = self._DIRECT_LAND_WIDTH
+        slots = np.concatenate([
+            np.full(len(it[1]), it[0], np.int32) for it in items])
+        means = np.concatenate([
+            np.asarray(it[1], np.float32) for it in items])
+        wts = np.concatenate([
+            np.asarray(it[2], np.float32) for it in items])
+        if self._dirty is not None:
+            self._mark_dirty(0, np.unique(slots))
+        for i in range(0, len(slots), W):
+            seg = slice(i, min(len(slots), i + W))
+            n = seg.stop - seg.start
+            ps = np.full(W, -1, np.int32)
+            pm = np.zeros(W, np.float32)
+            pw = np.zeros(W, np.float32)
+            ps[:n] = slots[seg]
+            pm[:n] = means[seg]
+            pw[:n] = wts[seg]
+            self.histo_bank = self._heng.merge_centroids(
+                self.histo_bank, ps, pm, pw)
+        sl = np.array([it[0] for it in items], np.int32)
+        self.histo_bank = self._heng.merge_scalars(
+            self.histo_bank, sl,
+            np.array([it[3] for it in items], np.float32),
+            np.array([it[4] for it in items], np.float32),
+            np.array([it[5] for it in items], np.float32),
+            np.array([it[6] for it in items], np.float32),
+            np.array([it[7] for it in items], np.float32))
         self.histo_bank = jax.device_put(self.histo_bank, self._device)
 
     # ---------------- flush ----------------
@@ -1374,7 +1438,12 @@ class AggregationEngine:
         the mesh engine's _flush_device)."""
         host = fetch_flush_outputs(out, self.cfg.flush_fetch,
                                    self._stage_exec)
-        return decompact_flush_host(host, tuple(self._agg_emit))
+        host = decompact_flush_host(host, tuple(self._agg_emit))
+        # host half of the set estimate (ULL's ML solve; identity for
+        # engines whose device program emits the finished estimate)
+        if "s_est" in host or "s_counts" in host:
+            self._seng.estimate_finalize(host)
+        return host
 
     def flush(self, timestamp: int | None = None) -> FlushResult:
         """The Server.Flush equivalent: snapshot banks, run the merge
@@ -1422,7 +1491,7 @@ class AggregationEngine:
         t_device = time.monotonic_ns()
 
         frame = MetricFrame(ts, cfg.hostname)
-        export = ForwardExport()
+        export = ForwardExport(set_engine=self._seng.id)
 
         # ---- histograms: vectorized gathers over the active set ----
         infos = active["histo"]
@@ -1632,6 +1701,27 @@ class AggregationEngine:
                 (2, "gauge_bank", self.gauge_keys),
                 (3, "set_bank", self.set_keys))
 
+    @property
+    def engine_stamp(self) -> str:
+        """The wire stamp of this engine's sketch pair — what the
+        forwarders send and the import paths compare against."""
+        return sketches.engine_stamp(self._heng, self._seng)
+
+    def engines_describe(self) -> dict:
+        """JSON-ready sketch-engine description (/debug/flush)."""
+        return sketches.describe(self._heng, self._seng)
+
+    def bank_leaf_names(self, kind: int) -> tuple:
+        """The durability leaf order for one bank kind — engine-aware
+        (the histogram and set banks' leaves are the selected engine's;
+        counter/gauge leaves are engine-independent)."""
+        if kind == 0:
+            return self._heng.bank_leaves
+        if kind == 3:
+            return self._seng.bank_leaves
+        from ..durability import records as drecords
+        return drecords.BANK_LEAVES[kind]
+
     def enable_dirty_tracking(self, delta_threshold: float = 0.5):
         """Arm per-bank dirty-slot bitmaps (the Server calls this when
         durability_engine_snapshot is on; the ROADMAP's incremental-
@@ -1675,7 +1765,7 @@ class AggregationEngine:
                 leaves: dict = {}
                 if ids.size:
                     gather = ids.size < self._delta_threshold * d.size
-                    for name in drecords.BANK_LEAVES[kind]:
+                    for name in self.bank_leaf_names(kind):
                         leaf = getattr(bank, name)
                         if gather:
                             leaves[name] = np.asarray(
@@ -1700,6 +1790,9 @@ class AggregationEngine:
                 "interner": interner,
                 "banks": banks,
                 "staged": staged,
+                "leaf_names": {
+                    kind: self.bank_leaf_names(kind)
+                    for kind, _attr, _ki in self._bank_table()},
                 "piles_total": piles_total,
                 "piles_dirty": piles_dirty,
             }
@@ -1729,7 +1822,7 @@ class AggregationEngine:
                     new_banks[attr] = bank     # fresh rows, already right
                     continue
                 host = {}
-                for name in drecords.BANK_LEAVES[kind]:
+                for name in self.bank_leaf_names(kind):
                     # fetch the fresh-init baseline (exact: vmin=+inf
                     # rows etc. come from the same _fresh_fn output the
                     # live process swapped in), overlay the rows

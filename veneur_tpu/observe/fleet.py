@@ -45,13 +45,18 @@ MAX_PENDING_INTERVALS = 8192
 
 class _SenderView:
     __slots__ = ("last_seen_ns", "newest_close_ns", "intervals_merged",
-                 "window")
+                 "window", "sketch_engines", "engine_rejects")
 
     def __init__(self, window: int):
         self.last_seen_ns = 0
         self.newest_close_ns = 0      # freshness watermark
         self.intervals_merged = 0
         self.window = deque(maxlen=window)   # e2e ms samples
+        # sketch-engine/wire stamp the sender last declared (None until
+        # a request carried a verdict) + rejected-request count — the
+        # mixed-fleet signature an operator reads BEFORE it degrades
+        self.sketch_engines = None
+        self.engine_rejects = 0
 
 
 def _percentile(sorted_vals, q: float) -> float:
@@ -101,6 +106,28 @@ class FleetView:
                 while len(self._pending) > MAX_PENDING_INTERVALS:
                     self._pending.popitem(last=False)
                     self.pending_dropped += 1
+
+    def note_stamp(self, sender_id: str, stamp: str | None,
+                   ok: bool) -> None:
+        """Record one request's sketch-engine stamp verdict (ISSUE 10):
+        the sender's declared engines (or "(legacy)" for unstamped
+        peers) and, on mismatch, the reject count — so /debug/fleet
+        shows a MIXED fleet per sender, not just an aggregate counter.
+
+        Liveness discipline: an ACCEPTED stamp only ANNOTATES a row the
+        normal admission path created (a request whose body then fails
+        decode must not look alive — the rejected-import rule); a
+        MISMATCH creates/touches the row — the sender IS alive and
+        misconfigured, which is exactly what the page must show."""
+        with self._lock:
+            if ok:
+                sv = self._senders.get(sender_id)
+                if sv is None:
+                    return
+            else:
+                sv = self._touch(sender_id, self._clock())
+                sv.engine_rejects += 1
+            sv.sketch_engines = stamp if stamp is not None else "(legacy)"
 
     def on_flush(self, now_ns: int) -> dict:
         """Flush boundary: everything admitted since the previous tick
@@ -153,6 +180,8 @@ class FleetView:
                         max(0.0, (now - sv.newest_close_ns) / 1e6)
                         if sv.newest_close_ns else None),
                     "intervals_merged": sv.intervals_merged,
+                    "sketch_engines": sv.sketch_engines,
+                    "engine_mismatch_rejects": sv.engine_rejects,
                     "pending": pending_by_sender.get(sid, 0),
                     "e2e_ms": {
                         "count": len(vals),
@@ -165,14 +194,18 @@ class FleetView:
                     "pending_dropped": self.pending_dropped}
 
 
+_NO_STAMP = object()   # "this request carried no stamp verdict"
+
+
 class _ImportScope:
     """Context for one import request: phases into the import ring,
     spans parented on the remote sender's flush span, fleet feed."""
 
     __slots__ = ("_obs", "tick", "env", "trace", "admitted", "n_metrics",
-                 "kind", "rejected")
+                 "kind", "rejected", "stamp")
 
-    def __init__(self, obs: "ImportObserver", env, trace, kind: str):
+    def __init__(self, obs: "ImportObserver", env, trace, kind: str,
+                 stamp=_NO_STAMP):
         self._obs = obs
         self.env = env
         self.trace = trace
@@ -180,6 +213,7 @@ class _ImportScope:
         self.n_metrics = 0
         self.kind = kind
         self.rejected = False       # 4xx'd before a dedupe verdict
+        self.stamp = stamp          # accepted engine stamp (None=legacy)
         self.tick = None
         if obs.flight is not None:
             # a PRIVATE record, published at __exit__: handler threads
@@ -231,6 +265,11 @@ class _ImportScope:
                 # body fails decode would mask it on the very page an
                 # operator consults to find it
                 obs.fleet.observe_interval(self.env[0], self.env[1], 0)
+            if self.stamp is not _NO_STAMP:
+                # annotate the row the feed above just created with
+                # the ACCEPTED engine stamp (mismatches never get
+                # here — the handler rejected before opening a scope)
+                obs.fleet.note_stamp(self.env[0], self.stamp, True)
         return False
 
 
@@ -251,11 +290,14 @@ class ImportObserver:
         c = self._client
         return c() if callable(c) else c
 
-    def request(self, env, trace, kind: str) -> _ImportScope:
+    def request(self, env, trace, kind: str,
+                stamp=_NO_STAMP) -> _ImportScope:
         """Open the observation scope for one import request. `env` is
         the decoded envelope tuple (or None), `trace` the decoded
-        trace-context tuple (or None), `kind` "grpc"/"http"."""
-        return _ImportScope(self, env, trace, kind)
+        trace-context tuple (or None), `kind` "grpc"/"http"; `stamp`
+        (when the handler checked one) is the ACCEPTED sketch-engine
+        stamp, annotated onto the sender's fleet row at scope exit."""
+        return _ImportScope(self, env, trace, kind, stamp)
 
     def debug_state(self, limit: int | None = 16) -> dict | None:
         if self.flight is None:

@@ -598,6 +598,45 @@ def merge_scalars(bank: TDigestBank, slots, vmins, vmaxs, vsums, counts,
     )
 
 
+def merge_banks(a: TDigestBank, b: TDigestBank,
+                compression: float = 100.0) -> TDigestBank:
+    """Slot-aligned union of two whole banks, BIT-COMMUTATIVE:
+    merge_banks(a, b) == merge_banks(b, a) bit-for-bit (the sketch-
+    engine property contract, tests/test_sketches.py). Both banks are
+    compressed, their centroid rows concatenated and CANONICALLY
+    sorted — lexicographic (canonical value key, weight bits, empties
+    strictly last), so the sorted multiset is order-independent — then
+    re-clustered through the ordinary k1 core. Scalar stats merge in
+    f64 (each 2Sum pair's exact value is f64(hi)+f64(lo); f64 addition
+    of the two exact values is commutative, unlike chained _two_sum
+    folds). Host-level API (the import/oracle path), not a serving
+    kernel."""
+    a = _compress_impl(a, compression)
+    b = _compress_impl(b, compression)
+    C = a.num_centroids
+    vals = jnp.concatenate([a.mean, b.mean], axis=1)
+    wts = jnp.concatenate([a.weight, b.weight], axis=1)
+    kv = _canonical_sort_key(jnp.where(wts > 0, vals, _INF))
+    # weights are non-negative, so their raw bits are order-monotone;
+    # empties key ABOVE any real weight so they sort strictly last even
+    # against genuine +inf values
+    kw = jnp.where(wts > 0,
+                   jax.lax.bitcast_convert_type(wts, jnp.uint32),
+                   jnp.uint32(0xFFFFFFFF))
+    _kv, _kw, vals, wts = jax.lax.sort((kv, kw, vals, wts), dimension=-1,
+                                       num_keys=2)
+    mean, weight = _cluster_core(vals, wts, compression, C,
+                                 sorted_prefix=vals.shape[1])
+    # the bit-commutative f64 scalar merge is single-homed in
+    # sketches/base.py (the engines' shared property contract);
+    # imported at call time — module-level would cycle through the
+    # sketches package's engine adapters back into this module
+    from ..sketches.base import merge_scalar_banks_np
+    scal = {k: jnp.asarray(v)
+            for k, v in merge_scalar_banks_np(a, b).items()}
+    return a._replace(mean=mean, weight=weight, **scal)
+
+
 @jax.jit
 def quantile(bank: TDigestBank, qs) -> jax.Array:
     """Batched MergingDigest.Quantile: [K] digests x [P] quantiles -> [K, P].

@@ -49,6 +49,12 @@ class MeshAggregationEngine(AggregationEngine):
             raise ValueError(
                 "mesh engine cannot forward upstream; point local "
                 "veneurs at this server's import listener instead")
+        if config.histogram_backend != "tdigest" \
+                or config.set_backend != "hll":
+            raise ValueError(
+                "mesh engine supports only the default sketch "
+                "backends (its sharded banks are built directly on "
+                "the t-digest/HLL ops)")
         self._mesh_cfg = (mesh, n_devices, n_dp)
         self._pad_cache: dict = {}
         self._import_h_points = 0
@@ -366,7 +372,14 @@ class MeshAggregationEngine(AggregationEngine):
             if self._import_h_points >= self.cfg.batch_size:
                 self._flush_import_centroids_locked()
 
-    def import_set(self, key, registers):
+    def import_set(self, key, registers, engine_id=None):
+        # the mesh engine is hll-only (constructor guard): a wire row
+        # tagged with another engine must reject THIS metric, matching
+        # the single-device engine's belt check
+        if engine_id is not None and engine_id != "hll":
+            raise ValueError(
+                f"set sketch engine mismatch: payload {engine_id!r}, "
+                "mesh banks run 'hll'")
         with self.lock:
             slot = self.set_keys.lookup(key, GLOBAL_ONLY)
             if slot == FOLD_SLOT:

@@ -538,6 +538,11 @@ class SpillBuffer:
         # key -> [means, weights, min, max, sum, count, recip]
         self._histos: dict = {}
         self._sets: dict = {}      # key -> registers u8[m]
+        # which set engine produced the spilled registers (same-key
+        # re-merge must use THAT engine's join — elementwise max for
+        # HLL, the lattice join for ULL); one server runs one engine,
+        # so the latest spilled export's id covers the whole buffer
+        self.set_engine = "hll"
         self._counters: dict = {}  # key -> float
         self._gauges: dict = {}    # key -> [value, age_in_failed_flushes]
         # gauge ages at the last merge_into, so a re-spill of the same
@@ -596,11 +601,14 @@ class SpillBuffer:
                 cur[5] += float(cnt)
                 cur[6] += float(recip)
             n += 1
+        self.set_engine = getattr(export, "set_engine", "hll")
+        from . import sketches
         for key, regs in export.sets:
             regs = np.asarray(regs, np.uint8)
             cur = self._sets.get(key)
             self._sets[key] = (regs if cur is None
-                               else np.maximum(cur, regs))
+                               else sketches.merge_registers(
+                                   self.set_engine, cur, regs))
             n += 1
         for key, value in export.counters:
             self._counters[key] = self._counters.get(key, 0.0) \
@@ -659,7 +667,24 @@ class SpillBuffer:
         export.histograms.extend(
             (key, h[0], h[1], h[2], h[3], h[4], h[5], h[6])
             for key, h in self._histos.items())
-        export.sets.extend(self._sets.items())
+        if self._sets and self.set_engine != getattr(
+                export, "set_engine", "hll"):
+            # a journal-restored spill from a DIFFERENT set backend
+            # (operator switched set_backend across a restart): the
+            # outgoing export can only tag one engine, so mis-tagged
+            # rows would merge under wrong semantics downstream —
+            # drop them loudly instead (counted; registers are the
+            # one spill type that cannot cross engines)
+            self.registry.incr(self.destination, "spill_evicted",
+                               len(self._sets))
+            log.warning(
+                "dropping %d spilled set sketches: spilled under "
+                "set_backend %r, forwarding under %r",
+                len(self._sets), self.set_engine,
+                getattr(export, "set_engine", "hll"))
+            n -= len(self._sets)
+        else:
+            export.sets.extend(self._sets.items())
         export.counters.extend(self._counters.items())
         export.gauges[:0] = [(key, v) for key, (v, _a)
                              in self._gauges.items()]

@@ -76,6 +76,11 @@ class Server:
             buffer_depth=cfg.tpu_buffer_depth,
             compression=cfg.tpu_compression,
             hll_precision=cfg.tpu_hll_precision,
+            histogram_backend=cfg.histogram_backend,
+            set_backend=cfg.set_backend,
+            ull_precision=cfg.tpu_ull_precision,
+            req_levels=cfg.tpu_req_levels,
+            req_capacity=cfg.tpu_req_capacity,
             percentiles=tuple(cfg.percentiles),
             aggregates=tuple(cfg.aggregates),
             idle_ttl_intervals=cfg.tpu_slot_idle_ttl_intervals,
@@ -101,6 +106,17 @@ class Server:
                             for _ in range(n_workers)]
         self.worker_queues: list[queue.Queue] = [
             queue.Queue(maxsize=65536) for _ in range(n_workers)]
+        # Sketch-engine/wire stamp (ISSUE 10): declared on every
+        # forwarded chunk and enforced on every import request — a
+        # mixed fleet (peer running different sketch backends) is
+        # refused loudly, never silently merged. One stamp per server:
+        # all engines share the config's backends.
+        self.engine_stamp = self.engines[0].engine_stamp
+        # Fleet-wide per-prefix cardinality (overload-defense
+        # satellite): received Huffman-Bucket sketches merge-by-max
+        # here, keyed by prefix; /debug/fleet serves the estimates.
+        self._fleet_sketch_lock = threading.Lock()
+        self._fleet_sketches: dict[str, bytearray] = {}
         self.native_bridge = None
         self.native_pump = None
         if cfg.native_ingest:
@@ -166,14 +182,16 @@ class Server:
                 forwarder = GrpcForwarder(
                     cfg.forward_address,
                     timeout_s=cfg.flush_timeout_seconds,
-                    egress_policy=self._egress_policy)
+                    egress_policy=self._egress_policy,
+                    engine_stamp=self.engine_stamp)
             else:
                 from .cluster.forward import HttpJsonForwarder
                 forwarder = HttpJsonForwarder(
                     cfg.forward_address,
                     timeout_s=cfg.flush_timeout_seconds,
                     max_per_body=cfg.flush_max_per_body,
-                    egress_policy=self._egress_policy)
+                    egress_policy=self._egress_policy,
+                    engine_stamp=self.engine_stamp)
         elif forwarder is None and cfg.consul_forward_service_name:
             # discover the global tier via Consul and re-resolve on the
             # refresh interval (consul.go; Server.RefreshDestinations)
@@ -187,7 +205,8 @@ class Server:
                 use_grpc=cfg.forward_use_grpc,
                 timeout_s=cfg.flush_timeout_seconds,
                 max_per_body=cfg.flush_max_per_body,
-                egress_policy=self._egress_policy)
+                egress_policy=self._egress_policy,
+                engine_stamp=self.engine_stamp)
         # Durable state (off by default): crash-safe journals for the
         # sender's replay ladder + spill tier and the receiver's dedupe
         # watermarks. Recovery runs HERE, in the constructor — before
@@ -1247,8 +1266,15 @@ class Server:
                     if idx in pending:
                         pending[idx]["keys"][kind] = (interval, entries)
                 elif rec_type == drecords.REC_ENGINE_BANK:
+                    # leaf order is engine-aware: decode with the
+                    # engines this server runs (a journal written by
+                    # DIFFERENT backends is refused at the fingerprint
+                    # check before any decoded rows can land)
                     idx, kind, ids, leaves = \
-                        drecords.decode_engine_bank(payload)
+                        drecords.decode_engine_bank(
+                            payload,
+                            leaf_names_of=self.engines[0]
+                            .bank_leaf_names)
                     if idx in pending:
                         pending[idx]["banks"][kind] = (ids, leaves)
                 elif rec_type == drecords.REC_ENGINE_STAGED:
@@ -1451,7 +1477,10 @@ class Server:
             addr, submit, ledger=self.dedupe_ledger,
             observer=self.import_observer,
             submit_batch=(self._submit_import_batch
-                          if self._engine_journal is not None else None))
+                          if self._engine_journal is not None else None),
+            engine_stamp=self.engine_stamp,
+            note_stamp=self._note_sketch_stamp,
+            merge_sketches=self.merge_prefix_sketches)
         self._grpc_servers.append(server)
         self.grpc_port = port
 
@@ -1479,6 +1508,9 @@ class Server:
             health=self.health_state,
             submit_batch=(self._submit_import_batch
                           if self._engine_journal is not None else None),
+            engine_stamp=self.engine_stamp,
+            note_stamp=self._note_sketch_stamp,
+            merge_sketches=self.merge_prefix_sketches,
             # the profiler trigger only exists when the operator opted
             # in via debug_flush_profile (a capture is a debug action)
             profile=(self.request_profile_capture
@@ -1791,6 +1823,7 @@ class Server:
             merged_export.sets.extend(res.export.sets)
             merged_export.counters.extend(res.export.counters)
             merged_export.gauges.extend(res.export.gauges)
+            merged_export.set_engine = res.export.set_engine
             ev, ch = eng.drain_events()
             events.extend(ev)
             checks.extend(ch)
@@ -1829,6 +1862,14 @@ class Server:
         self._fan_out(frameset, events, checks, tick=tick, parent=fo)
         if tick is not None:
             tick.finish(fo)
+
+        # per-prefix cardinality sketches ride to the global tier when
+        # the defense is on (merge-by-max there; advisory, excluded
+        # from the replay journal — a lost interval's rows are
+        # strictly dominated by the next interval's)
+        if self.admission is not None and self.forwarder is not None:
+            merged_export.prefix_sketches = \
+                self.admission.export_sketches()
 
         # forward when the interval produced exports OR earlier spilled
         # sketches await re-merge — an idle interval must still retry a
@@ -2019,6 +2060,9 @@ class Server:
         fwd = self.forwarder
         state = {
             "flush_count": self.flush_count,
+            # active sketch engines + wire stamp (ISSUE 10): what this
+            # server merges and declares on every forwarded chunk
+            "sketch_engines": self.engines[0].engines_describe(),
             "flight_recorder": (None if self.flight is None
                                 else self.flight.debug_state()),
             "forward": (fwd.debug_state()
@@ -2165,6 +2209,78 @@ class Server:
             "checks": checks,
         }
 
+    def _note_sketch_stamp(self, sender_id: str, stamp, ok: bool):
+        """Record one import request's engine-stamp verdict (both the
+        gRPC and HTTP paths route here): per-sender row in the fleet
+        view + the veneur.import.engine_mismatch_total counter on
+        reject — the loud half of the mixed-fleet contract."""
+        if self.fleet is not None:
+            self.fleet.note_stamp(sender_id, stamp, ok)
+        if not ok:
+            resilience.DEFAULT_REGISTRY.incr("import",
+                                             "import.engine_mismatch")
+
+    # distinct prefixes the fleet cardinality map will hold — the same
+    # bounded-memory posture as the admission controller's own
+    # max_prefixes (a network-facing receiver must stay bounded however
+    # many prefixes senders churn through); overflow rows are dropped
+    # and counted
+    MAX_FLEET_SKETCH_PREFIXES = 4096
+
+    def merge_prefix_sketches(self, items):
+        """Merge received per-prefix Huffman-Bucket cardinality rows
+        (merge-by-max — idempotent under replays) into the fleet map
+        served at /debug/fleet, so fleet-wide cardinality is ONE
+        estimate, not per-shard guesses. Bounded: prefixes past
+        MAX_FLEET_SKETCH_PREFIXES are dropped (counted), never grown."""
+        dropped = 0
+        with self._fleet_sketch_lock:
+            for prefix, regs in items:
+                cur = self._fleet_sketches.get(prefix)
+                if cur is None:
+                    if len(self._fleet_sketches) \
+                            >= self.MAX_FLEET_SKETCH_PREFIXES:
+                        dropped += 1
+                        continue
+                    self._fleet_sketches[prefix] = bytearray(regs)
+                elif len(cur) != len(regs):
+                    # senders configured with different sketch_buckets
+                    # cannot merge: DROP the row (counted) rather than
+                    # replace — a replace would flip-flop the prefix's
+                    # estimate between single-sender views per request
+                    dropped += 1
+                else:
+                    for i, r in enumerate(regs):
+                        if r > cur[i]:
+                            cur[i] = r
+        if dropped:
+            resilience.DEFAULT_REGISTRY.incr(
+                "import", "fleet.sketch_prefixes_dropped", dropped)
+
+    def _fleet_cardinality(self, top: int = 50) -> dict:
+        """JSON-ready fleet-wide per-prefix cardinality estimates:
+        received sketches merged (at read time) with this server's own
+        admission-controller sketches, so a global that also ingests
+        locally reports one number per prefix."""
+        from .ingest.admission import estimate_registers
+        with self._fleet_sketch_lock:
+            merged = {p: bytes(r) for p, r in self._fleet_sketches.items()}
+        if self.admission is not None:
+            for prefix, regs in self.admission.export_sketches():
+                cur = merged.get(prefix)
+                if cur is None:
+                    merged[prefix] = bytes(regs)
+                elif len(cur) == len(regs):
+                    merged[prefix] = bytes(
+                        max(a, b) for a, b in zip(cur, regs))
+                # width mismatch: keep the fleet row (local estimate
+                # is a subset of it anyway), never replace
+        rows = sorted(
+            ((p, round(estimate_registers(r), 1))
+             for p, r in merged.items()),
+            key=lambda kv: -kv[1])
+        return dict(rows[:top])
+
     def _debug_fleet_state(self) -> dict:
         """GET /debug/fleet payload: the per-sender fleet view (e2e
         p50/p99, freshness, last-seen, dedupe watermark) on a receiving
@@ -2212,6 +2328,17 @@ class Server:
             "flush_count": self.flush_count,
             "senders": senders,
             "forward": forward,
+            # mixed-fleet visibility (ISSUE 10): this server's engine
+            # stamp next to each sender's declared stamp above, plus
+            # the mismatch-reject total
+            "sketch_engines": {
+                "local": self.engine_stamp,
+                "mismatch_rejects": resilience.DEFAULT_REGISTRY.total(
+                    "import", "import.engine_mismatch"),
+            },
+            # fleet-wide per-prefix cardinality (merged received +
+            # local Huffman-Bucket sketches)
+            "fleet_cardinality": self._fleet_cardinality(),
             "import_recorder": (obs.debug_state() if obs is not None
                                 else None),
             "health": self.health_state(fwd_state=fwd_state),

@@ -1,0 +1,437 @@
+"""Relative-error quantile engine — adaptive compactors, batched.
+
+The alternative histogram engine (arxiv 2511.17396's relative-error
+streaming quantiles): per slot, a hierarchy of L fixed-capacity
+compactors holds ACTUAL SAMPLE VALUES as weighted items. Level
+buffers fill with weighted points; when one crosses its lazy trigger,
+the HIGHEST 5/8 section is PROTECTED (kept verbatim — the
+high-ranks-accurate mode, because the tail percentiles p99.9+ are
+what absolute-rank sketches blur on heavy-tailed data), while the
+lowest section is compacted pairwise — adjacent pairs collapse to one
+survivor at the pair's weighted GEOMETRIC mean (arithmetic fallback
+for non-positive values) carrying the pair's summed weight, so total
+weight is conserved exactly — and the survivors promote one level up.
+
+ERROR CONTRACT (documented, pinned by tests/test_sketches.py): the
+TAIL is the accurate end — p99.9 relative value error stays ~1% at
+the default budget even on pareto/log-uniform streams where a
+same-budget t-digest's k1 clusters average across wide value ranges
+(the config17 bench rows), because the top ranks live in protected
+sections as exact sample values; count/sum/min/max/avg/hmean are
+exact through the same 2Sum scalar leaves as the t-digest bank.
+Mid-range quantiles (p50-p99) ride the repeatedly-averaged compacted
+items and are DISTRIBUTION-DEPENDENT (tight on compact distributions,
+tens of percent on extreme heavy tails) — a deployment needing tight
+mid-range percentiles keeps `histogram_backend: tdigest`; this engine
+is for tail-latency SLOs.
+
+Bank layout ([K] slots, L levels x C capacity, T = L*C; default
+L=2, C=256 — the same ~4 KiB/slot budget as the default t-digest
+bank):
+  value, weight : f32[K, T]   level l occupies columns [l*C, (l+1)*C);
+                              live items are a dense prefix per level,
+                              weight 0 == empty
+  n             : i32[K, L]   per-level fill
+  ncomp         : i32[K]      compaction counter (stats; merges by
+                              SUM, keeping merge bit-commutative)
+  vmin/vmax/vsum/count/recip (+ _lo twins) : the shared exact scalars
+
+Wire/merge contract: the retained items ARE the export — they ride the
+forward wire as the same weighted-point rows a t-digest's centroids
+use, and merging is re-insertion (weights preserved), so the global
+tier's Combine machinery is engine-agnostic. merge_banks canonically
+sorts the union before re-inserting and sums ncomp, which makes
+merge(a, b) == merge(b, a) bit-for-bit (the property suite pins it).
+
+Zero canonicalization: -0.0 inputs are stored as +0.0 (matching the
+comparator canonicalization the t-digest sort applies), so the
+canonical item order — and therefore merge bit-identity — never
+depends on zero signs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import scatter
+from ..ops.tdigest import _interp_knots
+from . import base
+
+_INF = jnp.inf
+
+
+class REQBank(NamedTuple):
+    value: jax.Array      # f32[K, T]
+    weight: jax.Array     # f32[K, T]
+    n: jax.Array          # i32[K, L]
+    ncomp: jax.Array      # i32[K]
+    vmin: jax.Array       # f32[K]
+    vmax: jax.Array       # f32[K]
+    vsum: jax.Array       # f32[K]
+    count: jax.Array      # f32[K]
+    recip: jax.Array      # f32[K]
+    vsum_lo: jax.Array    # f32[K]
+    count_lo: jax.Array   # f32[K]
+    recip_lo: jax.Array   # f32[K]
+
+    @property
+    def num_slots(self):
+        return self.value.shape[0]
+
+    @property
+    def num_levels(self):
+        return self.n.shape[1]
+
+    @property
+    def capacity(self):
+        return self.value.shape[1] // self.n.shape[1]
+
+    @property
+    def buf_size(self):
+        # the hot-slot sidestep's per-landing headroom = one level
+        return self.capacity
+
+    @property
+    def num_centroids(self):
+        # total item budget (the role C plays for the t-digest bank)
+        return self.value.shape[1]
+
+
+def init(num_slots: int, levels: int = 2, capacity: int = 256) -> REQBank:
+    k, t = num_slots, levels * capacity
+    return REQBank(
+        value=jnp.zeros((k, t), jnp.float32),
+        weight=jnp.zeros((k, t), jnp.float32),
+        n=jnp.zeros((k, levels), jnp.int32),
+        ncomp=jnp.zeros((k,), jnp.int32),
+        vmin=jnp.full((k,), _INF, jnp.float32),
+        vmax=jnp.full((k,), -_INF, jnp.float32),
+        vsum=jnp.zeros((k,), jnp.float32),
+        count=jnp.zeros((k,), jnp.float32),
+        recip=jnp.zeros((k,), jnp.float32),
+        vsum_lo=jnp.zeros((k,), jnp.float32),
+        count_lo=jnp.zeros((k,), jnp.float32),
+        recip_lo=jnp.zeros((k,), jnp.float32),
+    )
+
+
+def _compact_level(bank: REQBank, lev: int) -> REQBank:
+    """One level's compaction, batched over K. A level triggers only
+    when its fill crosses TRIG = C - (C-P)/2 (below that it is left
+    intact — the lazy schedule that keeps compaction counts bounded
+    instead of re-compacting everything every cascade). On trigger,
+    the TOP P = 5C/8 items are protected verbatim (the high-ranks-
+    accurate section serving the tail percentiles) and the rest
+    collapse pairwise into weighted geometric means — exact weight
+    conservation, deterministic — which promote one level up (the top
+    level promotes into itself). The capacity arithmetic is
+    load-bearing: a level starts each cascade below TRIG and receives
+    at most (C-P)/2 promotions, and TRIG - 1 + (C-P)/2 <= C, so the
+    scatter never spills past the level (the p_ok clamp is a safety
+    net, not a steady-state path)."""
+    K = bank.num_slots
+    L, C = bank.num_levels, bank.capacity
+    a = lev * C
+    seg_v = bank.value[:, a:a + C]
+    seg_w = bank.weight[:, a:a + C]
+    # canonical ascending order: live items first by (value, weight),
+    # empties keyed +inf last
+    kv = jnp.where(seg_w > 0, seg_v, _INF)
+    _k, w_s, v_s = jax.lax.sort((kv, seg_w, seg_v), dimension=-1,
+                                num_keys=2)
+    nl = jnp.sum(seg_w > 0, axis=1).astype(jnp.int32)         # [K]
+    P = (5 * C) // 8
+    trig = C - (C - P) // 2
+    nb = jnp.where(nl >= trig, jnp.clip(nl - P, 0, C), 0)
+    nb = nb - (nb & 1)                                        # even
+    cols = jnp.arange(C, dtype=jnp.int32)[None, :]
+
+    # survivors of the compacted section: pair (2j, 2j+1) -> one item
+    # carrying the pair's summed weight at the pair's weighted
+    # GEOMETRIC mean when both members are positive (metric values are
+    # overwhelmingly positive and often heavy-tailed/log-symmetric —
+    # the arithmetic mean of a wide pair span reads above the span's
+    # rank midpoint and biases mid-quantiles high; the geometric mean
+    # is the log-space midpoint), falling back to the weighted
+    # arithmetic mean when either member is <= 0. Deterministic, so
+    # merge stays bit-commutative.
+    ev_v, od_v = v_s[:, 0::2], v_s[:, 1::2]
+    ev_w, od_w = w_s[:, 0::2], w_s[:, 1::2]
+    pw = ev_w + od_w
+    safe = jnp.where(pw > 0, pw, 1.0)
+    pv_arith = (ev_w * ev_v + od_w * od_v) / safe             # [K, C/2]
+    both_pos = (ev_v > 0) & (od_v > 0)
+    lv_e = jnp.log(jnp.where(ev_v > 0, ev_v, 1.0))
+    lv_o = jnp.log(jnp.where(od_v > 0, od_v, 1.0))
+    pv_geo = jnp.exp((ev_w * lv_e + od_w * lv_o) / safe)
+    pv = jnp.where(both_pos, pv_geo, pv_arith)
+    pj = jnp.arange(C // 2, dtype=jnp.int32)[None, :]
+    p_ok = pj < (nb // 2)[:, None]
+
+    # kept items (everything at/after nb) shift to the level's front
+    idx = jnp.minimum(cols + nb[:, None], C - 1)
+    keep_v = jnp.take_along_axis(v_s, idx, axis=1)
+    keep_w = jnp.take_along_axis(w_s, idx, axis=1)
+    keepm = cols < (nl - nb)[:, None]
+    keep_v = jnp.where(keepm, keep_v, 0.0)
+    keep_w = jnp.where(keepm, keep_w, 0.0)
+    n_keep = nl - nb
+
+    value = bank.value.at[:, a:a + C].set(keep_v)
+    weight = bank.weight.at[:, a:a + C].set(keep_w)
+    n = bank.n.at[:, lev].set(n_keep)
+
+    tgt = min(lev + 1, L - 1)
+    # for the self-promoting top level, n[:, tgt] was just set to the
+    # keep count above, so this reads correctly for both cases
+    bbase = n[:, tgt]
+    p_ok = p_ok & (bbase[:, None] + pj < C)   # never spill past the level
+    T = bank.value.shape[1]
+    gcol = jnp.where(p_ok, tgt * C + bbase[:, None] + pj, T)
+    rows = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None],
+                            gcol.shape)
+    value = value.at[rows, gcol].set(jnp.where(p_ok, pv, 0.0),
+                                     mode="drop")
+    weight = weight.at[rows, gcol].set(jnp.where(p_ok, pw, 0.0),
+                                       mode="drop")
+    n_add = jnp.sum(p_ok, axis=1).astype(jnp.int32)
+    n = n.at[:, tgt].add(n_add)
+    return bank._replace(value=value, weight=weight, n=n,
+                         ncomp=bank.ncomp + (nb > 0).astype(jnp.int32))
+
+
+def _compress_impl(bank: REQBank, levels: int, capacity: int) -> REQBank:
+    """The full compaction cascade, bottom-up — after it, a full
+    level 0 holds <= P items, so the add loop always makes progress."""
+    for lev in range(levels):
+        bank = _compact_level(bank, lev)
+    return bank
+
+
+def _add_items_impl(bank: REQBank, slots, values, weights,
+                    levels: int, capacity: int) -> REQBank:
+    """Scatter weighted items into level-0 buffers, compacting on
+    overflow (the merge_centroids path: scalars are NOT touched)."""
+    K = bank.num_slots
+    C = capacity
+    values = jnp.where(values == 0.0, 0.0, values)   # -0.0 -> +0.0
+    slots = jnp.where(weights > 0, slots, -1)
+    s, v, w = scatter.sort_by_slot(slots, values, weights, num_slots=K)
+    rank = scatter.run_ranks(s)
+    valid = s >= 0
+    sc = jnp.where(valid, s, 0)
+
+    def write_pass(bank, written):
+        done = scatter.segment_count(s, written & valid, K)
+        pos = bank.n[:, 0][sc] + rank - done[sc]
+        can = valid & ~written & (pos < C)
+        row = jnp.where(can, s, K)
+        col = jnp.clip(pos, 0, C - 1)
+        value = bank.value.at[row, col].set(v, mode="drop")
+        weight = bank.weight.at[row, col].set(w, mode="drop")
+        wrote = scatter.segment_count(s, can, K)
+        bank = bank._replace(value=value, weight=weight,
+                             n=bank.n.at[:, 0].add(wrote))
+        return bank, written | can
+
+    def cond(state):
+        _, written = state
+        return jnp.any(valid & ~written)
+
+    def body(state):
+        bank, written = state
+        bank, written = write_pass(bank, written)
+        leftover = jnp.any(valid & ~written)
+        bank = jax.lax.cond(
+            leftover,
+            lambda b: _compress_impl(b, levels, capacity),
+            lambda b: b, bank)
+        return bank, written
+
+    def loop_path(bank):
+        bank, _ = jax.lax.while_loop(
+            cond, body, (bank, jnp.zeros_like(valid)))
+        return bank
+
+    def fast_path(bank):
+        pos = bank.n[:, 0][sc] + rank
+        row = jnp.where(valid, s, K)
+        col = jnp.clip(pos, 0, C - 1)
+        return bank._replace(
+            value=bank.value.at[row, col].set(v, mode="drop"),
+            weight=bank.weight.at[row, col].set(w, mode="drop"),
+            n=bank.n.at[:, 0].add(batch_per_slot))
+
+    batch_per_slot = scatter.segment_count(s, valid, K)
+    overflows = jnp.any(bank.n[:, 0] + batch_per_slot > C)
+    return jax.lax.cond(overflows, loop_path, fast_path, bank)
+
+
+def _add_batch_impl(bank: REQBank, slots, values, weights,
+                    levels: int, capacity: int) -> REQBank:
+    """Histo.Sample equivalent: exact scalar stats + weighted items."""
+    K = bank.num_slots
+    valid = slots >= 0
+    sd = jnp.where(valid, slots, K)
+    bank = base.add_scalar_stats(bank, sd, valid, values, weights)
+    return _add_items_impl(bank, slots, values, weights, levels,
+                           capacity)
+
+
+def _quantile_impl(bank: REQBank, qs) -> jax.Array:
+    """Batched quantiles over the retained weighted items: per row,
+    sort the T items, place item i's mass center at (cum_i - w_i/2)/W
+    and interpolate (the same knot scheme as the t-digest quantile,
+    with exact min/max endpoints)."""
+    K, T = bank.value.shape
+    qs = jnp.asarray(qs, bank.value.dtype)
+    kv = jnp.where(bank.weight > 0, bank.value, _INF)
+    _k, w, v = jax.lax.sort((kv, bank.weight, bank.value), dimension=-1,
+                            num_keys=2)
+    total = jnp.sum(w, axis=1, keepdims=True)
+    safe_total = jnp.where(total > 0, total, 1.0)
+    cum = jnp.cumsum(w, axis=1)
+    mid_q = (cum - w / 2.0) / safe_total
+    mid_q = jnp.where(w > 0, mid_q, 1.0)
+    knot_q = jnp.concatenate(
+        [jnp.zeros((K, 1), mid_q.dtype), mid_q,
+         jnp.full((K, 1), 1.0, mid_q.dtype)], axis=1)
+    vmin = jnp.where(jnp.isfinite(bank.vmin), bank.vmin, 0.0)[:, None]
+    vmax = jnp.where(jnp.isfinite(bank.vmax), bank.vmax, 0.0)[:, None]
+    knot_v = jnp.concatenate(
+        [vmin, jnp.where(w > 0, v, vmax), vmax], axis=1)
+    out = _interp_knots(knot_q, knot_v, qs)
+    # strictly-positive rows interpolate in LOG space (knots are
+    # coarse in the averaged mid-range; heavy-tailed metric values
+    # track their CDF far better between geometric knots), matching
+    # the geometric pair survivors of the compactor
+    pos = (bank.vmin > 0) & jnp.isfinite(bank.vmin)
+    log_knots = jnp.log(jnp.maximum(knot_v, 1e-37))
+    out_log = jnp.exp(_interp_knots(knot_q, log_knots, qs))
+    out = jnp.where(pos[:, None], out_log, out)
+    return jnp.where(total > 0, out, 0.0)
+
+
+@dataclass(frozen=True)
+class REQEngine:
+    levels: int = 2
+    capacity: int = 256
+
+    id = "req"
+    wire_version = 1
+    import_strategy = "direct"    # re-insert foreign items, no clustering
+    bank_leaves = ("value", "weight", "n", "ncomp",
+                   "vmin", "vmax", "vsum", "count", "recip", "vsum_lo",
+                   "count_lo", "recip_lo")
+    error_contract = ("~1% relative value error at p99.9 (protected "
+                      "tail items are exact samples); mid-range "
+                      "distribution-dependent; exact count/sum/min/max")
+
+    def init(self, num_slots: int):
+        return init(num_slots, self.levels, self.capacity)
+
+    def add_batch_impl(self, bank, slots, values, weights):
+        return _add_batch_impl(bank, slots, values, weights,
+                               self.levels, self.capacity)
+
+    def compress_impl(self, bank):
+        return _compress_impl(bank, self.levels, self.capacity)
+
+    def merge_centroids_impl(self, bank, slots, means, weights):
+        return _add_items_impl(bank, slots, means, weights,
+                               self.levels, self.capacity)
+
+    def merge_scalars_impl(self, bank, slots, vmins, vmaxs, vsums,
+                           counts, recips):
+        return base.merge_scalar_stats(bank, slots, vmins, vmaxs,
+                                       vsums, counts, recips)
+
+    def quantile_impl(self, bank, qs):
+        return _quantile_impl(bank, qs)
+
+    def aggregates_impl(self, bank):
+        return base.scalar_aggregates(bank)
+
+    def forward_leaves(self, bank) -> dict:
+        return dict(
+            h_mean=bank.value, h_weight=bank.weight,
+            h_min=bank.vmin, h_max=bank.vmax,
+            h_sum=bank.vsum, h_sum_lo=bank.vsum_lo,
+            h_count=bank.count, h_count_lo=bank.count_lo,
+            h_recip=bank.recip, h_recip_lo=bank.recip_lo)
+
+    # ---- device-dispatching helpers (module-level jit cache) ----
+
+    def compress(self, bank):
+        return _compress_j(bank, self.levels, self.capacity)
+
+    def merge_centroids(self, bank, slots, means, weights):
+        return _add_items_j(bank, slots, means, weights, self.levels,
+                            self.capacity)
+
+    def merge_scalars(self, bank, slots, vmins, vmaxs, vsums, counts,
+                      recips):
+        return _merge_scalars_j(bank, slots, vmins, vmaxs, vsums,
+                                counts, recips)
+
+    # ---- donation ----
+
+    def donation_split(self):
+        """value/weight + the scalars alias h_* outputs verbatim; the
+        n/ncomp bookkeeping has no same-shaped output."""
+        return (("value", "weight", "vmin", "vmax", "vsum", "count",
+                 "recip", "vsum_lo", "count_lo", "recip_lo"),
+                ("n", "ncomp"))
+
+    def reassemble(self, core, bufs):
+        (value, weight, vmin, vmax, vsum, count, recip,
+         vsum_lo, count_lo, recip_lo) = core
+        return REQBank(value=value, weight=weight, n=bufs[0],
+                       ncomp=bufs[1], vmin=vmin, vmax=vmax, vsum=vsum,
+                       count=count, recip=recip, vsum_lo=vsum_lo,
+                       count_lo=count_lo, recip_lo=recip_lo)
+
+    # ---- host-level API ----
+
+    def merge_banks(self, a, b):
+        """Bit-commutative union: the canonical sort of the two item
+        sets is order-independent, ncomp merges by SUM, and the exact
+        scalars merge in f64 — merge(a, b) == merge(b, a) bit-for-bit."""
+        K, T = a.value.shape
+        vals = jnp.concatenate([a.value, b.value], axis=1)
+        wts = jnp.concatenate([a.weight, b.weight], axis=1)
+        kv = jnp.where(wts > 0, vals, _INF)
+        _k, wts, vals = jax.lax.sort((kv, wts, vals), dimension=-1,
+                                     num_keys=2)
+        out = self.init(K)
+        out = out._replace(ncomp=a.ncomp + b.ncomp,
+                           **base.merge_scalar_banks_np(a, b))
+        C = self.capacity
+        slots_flat = jnp.repeat(jnp.arange(K, dtype=jnp.int32), C)
+        for c0 in range(0, 2 * T, C):
+            chunk = slice(c0, c0 + C)
+            out = _add_items_j(out, slots_flat,
+                               vals[:, chunk].reshape(-1),
+                               wts[:, chunk].reshape(-1),
+                               self.levels, self.capacity)
+        return out
+
+    def state_bytes(self, num_slots: int = 1) -> int:
+        bank = init(1, self.levels, self.capacity)
+        per = sum(np.asarray(leaf).nbytes for leaf in bank)
+        return per * num_slots
+
+
+_compress_j = partial(jax.jit,
+                      static_argnames=("levels", "capacity"))(
+    _compress_impl)
+_add_items_j = partial(jax.jit,
+                       static_argnames=("levels", "capacity"))(
+    _add_items_impl)
+_merge_scalars_j = jax.jit(base.merge_scalar_stats)
