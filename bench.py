@@ -411,6 +411,14 @@ def worker(k: int, budget_s: float, platform: str,
             copy = jax.tree_util.tree_map(jnp.copy, bank)
             jax.block_until_ready(copy.mean)
             eng.histo_bank = copy
+            # every slot is warm in this worst-case bank: mark the
+            # whole dirty bitmap so the injected state is visible to
+            # the serving flush (above the incremental threshold it
+            # takes the full program — the honest 100%-dirty e2e;
+            # config18 of bench_suite.py carries the dirty-fraction
+            # A/B rows)
+            if eng._dirty is not None:
+                eng._dirty[0][:] = True
             cur = eng.histo_keys.interval
             for info in eng.histo_keys._map.values():
                 info.last_interval = cur

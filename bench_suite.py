@@ -1951,6 +1951,282 @@ def config17_sketch_engines():
         flush_rows(label, hb, sb, 100_352, 2)
 
 
+def config18_incremental_flush():
+    """Incremental dirty-slot flush + double-buffered swap (ISSUE 11).
+
+    Row family A — exec-only A/B at the default engine pair: the FULL
+    fused flush program vs the INCREMENTAL gather/compute program over
+    banks whose dirty rows carry the steady-state worst case (warm
+    centroid prefix + full sample buffer — the bench.py bank shape)
+    and whose cold rows are fresh-init, at 10% / 50% / 100% dirty on
+    the 1.6k (c12) and 100k (north-star) histogram shapes.
+    block_until_ready basis, no fetch, non-donating builds — the same
+    exec-only discipline as bench.py. The acceptance gate is >= 5x
+    exec reduction at 100k / 10% dirty on CPU; at 100% dirty the
+    incremental arm measures pure gather overhead (serving falls back
+    to the full program above tpu_flush_incremental_threshold).
+
+    Row family B — per-engine rows (tdigest|req x hll|ull) at the
+    1.6k shape / 10% dirty, through the registry: all four backends
+    ride the same incremental machinery.
+
+    Row family C — ingest-stall-during-flush: max admit (process())
+    latency observed by a concurrent ingest thread while flush() runs,
+    double-buffered vs legacy drain-under-lock ordering, with a staged
+    import backlog so the legacy lock window is realistic.
+
+    Row family D — a real engine.flush() tick on the 100k bank with
+    the /debug/flush phase stamps (gather / device.exec / scatter) so
+    the artifact carries the before/after phase timeline, not only the
+    A/B scalars."""
+    import threading
+
+    import jax
+
+    from veneur_tpu.ingest.parser import MetricKey, UDPMetric
+    from veneur_tpu.models import pipeline
+    from veneur_tpu.models.pipeline import (AggregationEngine,
+                                            EngineConfig)
+    from veneur_tpu.ops import tdigest
+
+    dev = jax.devices()[0]
+    qs = np.asarray([0.5, 0.99], np.float32)
+    agg_emit = ("min", "max", "count")
+    rng = np.random.default_rng(11)
+    BUF = 256
+
+    def mk_banks(K, dirty_ids):
+        """Full-[K] bank set whose dirty rows are the steady-state
+        worst case and whose cold rows are exactly fresh-init. The
+        warm centroid prefix comes from ONE [D]-sized device compress
+        (cheap at 10%), scattered into the host arrays."""
+        D = len(dirty_ids)
+        proto = tdigest.init(1, compression=100.0, buf_size=BUF)
+        c = proto.num_centroids
+        bv1 = rng.gamma(2.0, 20.0, (D, BUF)).astype(np.float32)
+        bv2 = rng.gamma(2.0, 20.0, (D, BUF)).astype(np.float32)
+        both = np.concatenate([bv1, bv2], axis=1)
+        small = tdigest.TDigestBank(
+            mean=np.zeros((D, c), np.float32),
+            weight=np.zeros((D, c), np.float32),
+            buf_value=bv1, buf_weight=np.ones((D, BUF), np.float32),
+            buf_n=np.full((D,), BUF, np.int32),
+            vmin=both.min(axis=1), vmax=both.max(axis=1),
+            vsum=both.sum(axis=1, dtype=np.float64).astype(np.float32),
+            count=np.full((D,), 2.0 * BUF, np.float32),
+            recip=(1.0 / both).sum(axis=1, dtype=np.float64).astype(
+                np.float32),
+            vsum_lo=np.zeros((D,), np.float32),
+            count_lo=np.zeros((D,), np.float32),
+            recip_lo=np.zeros((D,), np.float32))
+        small = tdigest.compress(jax.device_put(small, dev),
+                                 compression=100.0)
+        small = jax.device_get(small)
+        hb = jax.device_get(tdigest.init(K, 100.0, BUF))
+        for name in ("mean", "weight", "vmin", "vmax", "vsum", "count",
+                     "recip"):
+            arr = np.array(np.asarray(getattr(hb, name)))
+            arr[dirty_ids] = np.asarray(getattr(small, name))
+            hb = hb._replace(**{name: arr})
+        bw = np.array(np.asarray(hb.buf_value))
+        bw[dirty_ids] = bv2
+        hb = hb._replace(
+            buf_value=bw,
+            buf_weight=np.array(np.asarray(hb.buf_weight)),
+            buf_n=np.array(np.asarray(hb.buf_n)))
+        hb.buf_weight[dirty_ids] = 1.0
+        hb.buf_n[dirty_ids] = BUF
+        from veneur_tpu.ops import hll, scalar
+        banks = (jax.device_put(hb, dev),
+                 jax.device_put(scalar.init_counters(64), dev),
+                 jax.device_put(scalar.init_gauges(64), dev),
+                 jax.device_put(hll.init(64, 14), dev))
+        jax.block_until_ready(banks)
+        return banks
+
+    from veneur_tpu.sketches.hll_engine import HLLEngine
+    from veneur_tpu.sketches.tdigest_engine import TDigestEngine
+    heng = TDigestEngine(compression=100.0, buffer_depth=BUF)
+    seng = HLLEngine(precision=14)
+
+    def time_exec(fn, args, iters=3):
+        jax.block_until_ready(fn(*args))          # compile
+        out = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            out.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(out))
+
+    def ab_rows(K, fracs, iters):
+        full = pipeline._flush_executable(dev, heng, seng, False,
+                                          agg_emit, False, donate=False)
+        inc = pipeline._inc_flush_executable(dev, heng, seng, False,
+                                             agg_emit, False)
+        label = f"{K // 1000}k" if K >= 1000 else str(K)
+        rows = {}
+        for frac in fracs:
+            D = max(1, int(K * frac))
+            dirty_ids = np.sort(rng.choice(K, D, replace=False)) \
+                .astype(np.int32)
+            banks = mk_banks(K, dirty_ids)
+            if "full" not in rows:
+                rows["full"] = time_exec(
+                    full, banks + (qs,), iters)
+                _emit(f"c18_exec_full_ms_{label}", rows["full"], "ms",
+                      None, note="full fused program, exec-only "
+                      "(block_until_ready, no fetch), worst-case "
+                      "dirty rows")
+            one = np.zeros(1, np.int32)
+            idx = [pipeline.pad_dirty_ids(dirty_ids, K),
+                   pipeline.pad_dirty_ids(one, 64),
+                   pipeline.pad_dirty_ids(one, 64),
+                   pipeline.pad_dirty_ids(one, 64)]
+            ms = time_exec(inc, banks + (qs,) + tuple(idx), iters)
+            pct = int(round(frac * 100))
+            _emit(f"c18_exec_incremental_ms_{label}_{pct}pct_dirty",
+                  ms, "ms", None, dirty=int(D),
+                  bucket=int(len(idx[0])))
+            _emit(f"c18_exec_reduction_x_{label}_{pct}pct_dirty",
+                  rows["full"] / max(ms, 1e-6), "ratio",
+                  5.0 if (K >= 100_000 and pct == 10) else None,
+                  note="full/incremental exec ratio"
+                  + ("; ACCEPTANCE GATE >= 5x" if
+                     (K >= 100_000 and pct == 10) else ""))
+            rows[frac] = ms
+        return rows
+
+    ab_rows(1024, (0.10, 0.50, 1.00), iters=5)
+    rows_100k = ab_rows(100_000, (0.10, 0.50, 1.00), iters=2)
+
+    # ---- family D: a real flush tick at 100k / 10% with phase stamps
+    K = 100_000
+    D = K // 10
+    dirty_ids = np.sort(rng.choice(K, D, replace=False)).astype(np.int32)
+    eng = AggregationEngine(EngineConfig(
+        histogram_slots=K, counter_slots=64, gauge_slots=64,
+        set_slots=64, buffer_depth=BUF, percentiles=(0.5, 0.99),
+        aggregates=agg_emit))
+    for i in range(K):
+        eng.histo_keys.lookup(MetricKey(f"svc.lat.{i}", "timer", ""), 0)
+    # production warmup() pre-builds the empty-flush baseline; do the
+    # same here so the gather phase reads steady-state, not the one-off
+    # K=1 baseline compile
+    eng._flush_baseline_rows()
+    banks = mk_banks(K, dirty_ids)
+    with eng.lock:
+        (eng.histo_bank, eng.counter_bank,
+         eng.gauge_bank, eng.set_bank) = banks
+        eng._dirty[0][dirty_ids] = True
+    res = eng.flush(timestamp=2)
+    ph = {name: (t1 - t0) / 1e6 for name, t0, t1 in
+          res.stats["phases"]}
+    _emit("c18_tick_device_exec_ms_100k_10pct", ph.get(
+        "device.exec", 0.0), "ms", None,
+        flush_path=res.stats["flush_path"],
+        gather_ms=round(ph.get("gather", 0.0), 2),
+        scatter_ms=round(ph.get("scatter", 0.0), 2),
+        materialize_ms=round(ph.get("materialize", 0.0), 2),
+        note="real engine.flush() tick, incremental path, the "
+             "/debug/flush phase timeline in row form")
+    del eng, banks
+
+    # ---- family B: per-engine rows at the 1.6k shape / 10% dirty
+    for hb_name in ("tdigest", "req"):
+        for sb_name in ("hll", "ull"):
+            e = AggregationEngine(EngineConfig(
+                histogram_slots=1024, counter_slots=128, gauge_slots=128,
+                set_slots=64, batch_size=2048, buffer_depth=BUF,
+                percentiles=(0.5, 0.99), aggregates=agg_emit,
+                histogram_backend=hb_name, set_backend=sb_name))
+            erng = np.random.default_rng(5)
+            for k in range(102):
+                s = e.histo_keys.lookup(
+                    MetricKey(f"p.h{k}", "timer", ""), 0)
+                e.ingest_histo_batch(
+                    np.full(64, s, np.int32),
+                    erng.gamma(2, 20, 64).astype(np.float32),
+                    np.ones(64, np.float32), count=64)
+            with e.lock:
+                e.drain_all()
+                banks = (e.histo_bank, e.counter_bank, e.gauge_bank,
+                         e.set_bank)
+                ids = [np.nonzero(d)[0].astype(np.int32)
+                       for d in e._dirty]
+            full = pipeline._flush_executable(
+                dev, e._heng, e._seng, False, agg_emit, False,
+                donate=False)
+            inc = pipeline._inc_flush_executable(
+                dev, e._heng, e._seng, False, agg_emit, False)
+            idx = [pipeline.pad_dirty_ids(i, d.size)
+                   for d, i in zip(e._dirty, ids)]
+            f_ms = time_exec(full, banks + (qs,), 3)
+            i_ms = time_exec(inc, banks + (qs,) + tuple(idx), 3)
+            _emit(f"c18_exec_reduction_x_1k_{hb_name}_{sb_name}",
+                  f_ms / max(i_ms, 1e-6), "ratio", None,
+                  full_ms=round(f_ms, 1), incremental_ms=round(i_ms, 1),
+                  dirty=int(ids[0].size),
+                  note="10pct dirty, engine registry pair")
+            del e, banks
+
+    # ---- family C: ingest stall during flush, double-buffered vs
+    # legacy ordering (staged import backlog makes the legacy lock
+    # window realistic)
+    def stall_row(dbuf):
+        e = AggregationEngine(EngineConfig(
+            histogram_slots=1024, counter_slots=2048, gauge_slots=512,
+            set_slots=256, batch_size=2048, buffer_depth=BUF,
+            percentiles=(0.5, 0.99), aggregates=agg_emit,
+            is_global=True, flush_double_buffer=dbuf))
+        e.warmup()
+        srng = np.random.default_rng(9)
+        for k in range(256):
+            s = e.histo_keys.lookup(MetricKey(f"s.h{k}", "timer", ""), 0)
+            e.ingest_histo_batch(np.full(64, s, np.int32),
+                                 srng.gamma(2, 20, 64).astype(np.float32),
+                                 np.ones(64, np.float32), count=64)
+        for k in range(1024):
+            means = np.sort(srng.normal(100, 9, 48).astype(np.float32))
+            e.import_histogram(MetricKey(f"s.i{k}", "timer", ""), means,
+                               np.ones(48, np.float32),
+                               float(means.min()), float(means.max()),
+                               float(means.sum()), 48.0, 0.1)
+        m = UDPMetric(MetricKey("s.h0", "timer", ""), 0, 1.5, 1.0, 0)
+        lat = []
+        done = threading.Event()
+
+        def probe():
+            while not done.is_set():
+                t0 = time.perf_counter()
+                e.process(m)
+                lat.append(time.perf_counter() - t0)
+
+        th = threading.Thread(target=probe, daemon=True)
+        th.start()
+        t0 = time.perf_counter()
+        e.flush(timestamp=3)
+        flush_s = time.perf_counter() - t0
+        done.set()
+        th.join(5.0)
+        assert lat, "admit probe thread never ran"
+        return float(np.max(lat) * 1e3), flush_s, len(lat)
+
+    max_dbuf, fs1, n1 = stall_row(True)
+    max_legacy, fs2, n2 = stall_row(False)
+    _emit("c18_admit_stall_max_ms_double_buffered", max_dbuf, "ms",
+          None, larger_is_better=False, flush_s=round(fs1, 2),
+          admits=n1,
+          note="max process() latency on a concurrent ingest thread "
+               "while flush() runs — lock held only for the "
+               "retire-and-swap")
+    _emit("c18_admit_stall_max_ms_legacy", max_legacy, "ms", None,
+          larger_is_better=False, flush_s=round(fs2, 2), admits=n2,
+          note="legacy ordering: drain + staged-import landing under "
+               "the ingest lock before the swap")
+    _emit("c18_admit_stall_reduction_x",
+          max_legacy / max(max_dbuf, 1e-6), "ratio", None)
+
+
 CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            3: config3_sets_1m_uniques, 4: config4_forward_merge_32_shards,
            5: config5_multichip_100k, 6: config6_e2e_udp_ingest,
@@ -1962,7 +2238,8 @@ CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            14: config14_admission_defense,
            15: config15_fleet_tracing,
            16: config16_engine_checkpoint,
-           17: config17_sketch_engines}
+           17: config17_sketch_engines,
+           18: config18_incremental_flush}
 
 
 def _run_isolated(configs: list[int], json_out: str) -> int:

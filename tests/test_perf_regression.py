@@ -156,18 +156,27 @@ def test_admission_overhead_under_2pct_of_parse_cost():
 
 def test_no_unusable_donation_warnings():
     """Every donated buffer must actually alias an output (ISSUE 3
-    satellite): the flush executable used to donate all four banks while
-    producing only compact [K, ·] outputs, so XLA warned "Some donated
-    buffers were not usable" on every compile — in every bench run and
-    at every serving start. Donation is now scoped to the banks whose
-    leaves all alias outputs; this compiles the full serving path
-    (ingest kernels + hot-slot programs + flush program, at shapes no
-    other test uses, so the compile genuinely happens) and fails on any
+    satellite, extended to the ISSUE 11 shadow bank): the flush
+    executable used to donate all four banks while producing only
+    compact [K, ·] outputs, so XLA warned "Some donated buffers were
+    not usable" on every compile — in every bench run and at every
+    serving start. Donation is now scoped to the banks whose leaves
+    all alias outputs; the incremental dirty-slot executable donates
+    NOTHING (its compact outputs cannot alias the full banks — a
+    donation request there would bring the warning back). This
+    compiles the full serving path (ingest kernels + hot-slot
+    programs, the full AND incremental flush programs, the shadow-
+    bank swap, at shapes no other test uses so the compiles genuinely
+    happen) across a double-buffered multi-tick run and fails on any
     donation warning."""
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         # local-only build AND a forwarding build (fwd_out emits the
-        # raw sketch state, which changes which banks fully alias)
+        # raw sketch state, which changes which banks fully alias).
+        # Both ticks of the double-buffered run take the incremental
+        # (non-donating) program; the DONATED full program compiles in
+        # warmup() below — both compiles happen inside the
+        # warnings-capture window, so the audit covers both paths.
         for fwd in (False, True):
             eng = AggregationEngine(EngineConfig(
                 histogram_slots=272 + fwd, counter_slots=24,
@@ -175,14 +184,17 @@ def test_no_unusable_donation_warnings():
                 buffer_depth=16, percentiles=(0.5, 0.99),
                 aggregates=("min", "max", "count"),
                 forward_enabled=fwd))
+            assert eng._use_double_buffer and eng._use_incremental
             eng.warmup()
             s = eng.histo_keys.lookup(MetricKey("don.t", "timer", ""), 0)
-            eng.ingest_histo_batch(
-                np.full(112, s, np.int32),
-                np.linspace(0.0, 1.0, 112, dtype=np.float32),
-                np.ones(112, np.float32), count=112)
-            res = eng.flush(timestamp=1)
-            assert res.frame is not None
+            for tick in (1, 2):
+                eng.ingest_histo_batch(
+                    np.full(112, s, np.int32),
+                    np.linspace(0.0, 1.0, 112, dtype=np.float32),
+                    np.ones(112, np.float32), count=112)
+                res = eng.flush(timestamp=tick)
+                assert res.frame is not None
+                assert res.stats["flush_path"]["path"] == "incremental"
     bad = [str(w.message) for w in caught
            if "donated buffers were not usable" in str(w.message)]
     assert bad == [], "\n".join(bad)
@@ -281,8 +293,16 @@ def test_engine_checkpoint_steady_state_under_10pct_of_tick():
     per BATCH."""
     from veneur_tpu.durability import records as drec
 
-    # default engine: dirty tracking off is the no-op baseline
-    assert AggregationEngine(EngineConfig())._dirty is None
+    # the dirty bitmap now has two consumers (ISSUE 11): the default
+    # engine arms it for the incremental flush; disabling BOTH
+    # consumers is the structural no-op baseline (one attribute load
+    # per landing batch)
+    small = dict(histogram_slots=256, counter_slots=128,
+                 gauge_slots=128, set_slots=64, batch_size=256,
+                 buffer_depth=16)
+    assert AggregationEngine(EngineConfig(**small))._dirty is not None
+    assert AggregationEngine(EngineConfig(
+        flush_incremental=False, **small))._dirty is None
 
     cfg = EngineConfig(histogram_slots=1024, counter_slots=2048,
                        gauge_slots=512, set_slots=256,

@@ -235,3 +235,30 @@ def test_sk01_pipeline_routes_through_registry():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(repo, "veneur_tpu", "models", "pipeline.py")
     assert [v for v in run_paths([path]) if v.rule == "SK01"] == []
+
+
+def test_ds01_unmarked_bank_landings():
+    # one finding per function, at its first landing line: the bank-
+    # attr assignment through _kern, the inert-helper delegation, and
+    # the landing-leaf call in the helper itself; the marked, the
+    # marking-helper-delegating, and the suppressed functions stay
+    # silent
+    assert lint("ds01_bad.py") == [("DS01", 11), ("DS01", 29),
+                                   ("DS01", 34)]
+
+
+def test_ds01_pipeline_landing_sites_all_marked():
+    # the bitmap feeds BOTH delta checkpoints and the incremental
+    # flush (ISSUE 11): every device-landing write in the live
+    # pipeline must mark, or carry a documented suppression
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "models", "pipeline.py")
+    assert [v for v in run_paths([path]) if v.rule == "DS01"] == []
+
+
+def test_ds01_out_of_scope_modules_unchecked():
+    # the mesh engine carries no per-slot bitmaps (excluded from both
+    # consumers) — its bank writes are not DS01's business
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "parallel", "engine.py")
+    assert [v for v in run_paths([path]) if v.rule == "DS01"] == []

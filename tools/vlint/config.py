@@ -112,6 +112,16 @@ DEFAULT_CONFIG = {
         "veneur_tpu/ops/",
         "veneur_tpu/parallel/",
     ),
+    # DS01: dirty-bitmap marking discipline (path substring match;
+    # /ds01_ scopes the check's own fixture in): every device-landing
+    # bank write in the pipeline module must mark the dirty bitmap —
+    # it feeds BOTH the incremental flush and delta checkpoints
+    # (ISSUE 11). Non-landing writes (fresh swap, warmup padding,
+    # setup) carry documented suppressions.
+    "ds01_scope": (
+        "veneur_tpu/models/pipeline.py",
+        "/ds01_",
+    ),
     # TR01: where the trace-context wire-literal monopoly applies
     # (path substring match; /tr01_ scopes the check's own fixture in)
     # and the one module allowed to spell the forward trace headers /

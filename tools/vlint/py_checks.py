@@ -1,5 +1,5 @@
 """Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02, DR01,
-DR02, TL01, OV01.
+DR02, TL01, OV01, SK01, DS01.
 
 All checks are intentionally conservative: they resolve only what can
 be resolved statically within the project (local jit wrappers, module
@@ -1114,6 +1114,125 @@ def check_sk01(mod: PyModule, config: dict) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------------------- DS01
+
+_DS01_BANK_ATTRS = ("histo_bank", "counter_bank", "gauge_bank",
+                    "set_bank")
+# method leaves that LAND data into a bank without assigning a bank
+# attribute (the pure landing cores return banks to their caller)
+_DS01_LANDING_LEAVES = ("merge_rows", "merge_centroids",
+                        "merge_scalars", "counter_merge", "gauge_set")
+_DS01_MARK_LEAVES = ("_mark_dirty", "_mark_dirty_into")
+
+
+def _ds01_direct_mark(fn: ast.AST) -> bool:
+    """Does this function mark a dirty bitmap directly — a
+    *_mark_dirty(_into) call, or a subscript STORE whose base chain
+    names something dirty (`dirty[0][ids] = True`,
+    `self._dirty[kind][ids] = True`)?"""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            d = dotted(n.func)
+            if d is not None and \
+                    d.rsplit(".", 1)[-1] in _DS01_MARK_LEAVES:
+                return True
+        elif isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = (n.targets if isinstance(n, ast.Assign)
+                       else [n.target])
+            for t in targets:
+                if not isinstance(t, ast.Subscript):
+                    continue
+                base = t
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                name = (base.attr if isinstance(base, ast.Attribute)
+                        else base.id if isinstance(base, ast.Name)
+                        else "")
+                if "dirty" in name:
+                    return True
+    return False
+
+
+def _ds01_landing_lines(fn: ast.AST) -> list[int]:
+    """Line numbers of device-landing bank writes inside `fn`: an
+    assignment binding a `*_bank` attribute, a `self._kern[...]`
+    kernel dispatch, or a call to one of the bank-landing method
+    leaves (merge_rows & co — the cores that return updated banks)."""
+    lines = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            targets = []
+            for t in n.targets:
+                targets.extend(t.elts if isinstance(
+                    t, (ast.Tuple, ast.List)) else [t])
+            if any(isinstance(t, ast.Attribute)
+                   and t.attr in _DS01_BANK_ATTRS for t in targets):
+                lines.append(n.lineno)
+        elif isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Subscript) and isinstance(
+                    n.func.value, ast.Attribute) \
+                    and n.func.value.attr == "_kern":
+                lines.append(n.lineno)
+            else:
+                d = dotted(n.func)
+                if d is not None and \
+                        d.rsplit(".", 1)[-1] in _DS01_LANDING_LEAVES:
+                    lines.append(n.lineno)
+    return sorted(set(lines))
+
+
+def check_ds01(mod: PyModule, config: dict) -> list[Violation]:
+    """Dirty-bitmap marking discipline (ISSUE 11): the dirty-slot
+    bitmap feeds BOTH the delta checkpoints and the incremental flush
+    — an unmarked device-landing write silently drops data from the
+    next flush AND the next checkpoint, so marking is a machine-
+    checked invariant, not folklore. Inside the scope (the pipeline
+    module owning the banks), every function containing a device-
+    landing bank write must mark a dirty bitmap: directly
+    (*_mark_dirty(_into) call, or a subscript store on a dirty
+    bitmap), or by calling — transitively, within the module — a
+    function that does. Non-landing bank writes (the fresh-bank swap,
+    warmup's all-padding batches, initial setup) suppress with a
+    documented reason. One finding per function, at its first landing
+    line."""
+    if not any(m in mod.path for m in config["ds01_scope"]):
+        return []
+    fns = [n for n in ast.walk(mod.tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    marking = {fn.name for fn in fns if _ds01_direct_mark(fn)}
+    # transitive closure over intra-module calls: a function that
+    # calls a marking function (by leaf name) is itself marking —
+    # wrappers delegate to the landing cores that own the mark
+    changed = True
+    while changed:
+        changed = False
+        for fn in fns:
+            if fn.name in marking:
+                continue
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    d = dotted(n.func)
+                    if d is not None and \
+                            d.rsplit(".", 1)[-1] in marking:
+                        marking.add(fn.name)
+                        changed = True
+                        break
+    out = []
+    for fn in fns:
+        lines = _ds01_landing_lines(fn)
+        if not lines or fn.name in marking:
+            continue
+        out.append(Violation(
+            mod.path, lines[0], "DS01",
+            f"device-landing bank write in `{fn.name}` without a "
+            "dirty-bitmap mark — the bitmap feeds the incremental "
+            "flush AND delta checkpoints, so an unmarked landing "
+            "silently drops the slot from both; mark via "
+            "_mark_dirty(_into) (or a marking helper), or suppress "
+            "with a reason proving this write is not a data landing"))
+    return out
+
+
 # ------------------------------------------------------------------- driver
 
 def check_module(mod: PyModule, ctx: Context, config: dict
@@ -1132,4 +1251,5 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_tr01(mod, config))
     out.extend(check_ov01(mod, config))
     out.extend(check_sk01(mod, config))
+    out.extend(check_ds01(mod, config))
     return out

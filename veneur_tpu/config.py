@@ -300,6 +300,23 @@ class Config:
     # Halves the flush fetch on transport-constrained rigs. Not
     # supported with multi-device engines.
     tpu_flush_fetch_f16: bool = False
+    # Incremental dirty-slot flush (ISSUE 11): the flush program
+    # consumes the delta-checkpoint dirty bitmap and compresses/
+    # materializes ONLY the piles touched this interval — cold piles
+    # keep their fresh-init state and baseline rows verbatim,
+    # bit-identical to the full program. Above the threshold dirty
+    # fraction (histogram bank) the full program runs instead. Ignored
+    # (always full) with tpu_num_devices > 1 — the mesh engine owns
+    # sharded banks with no per-slot bitmaps.
+    tpu_flush_incremental: bool = True
+    tpu_flush_incremental_threshold: float = 0.75
+    # Double-buffered flush (ISSUE 11): the tick boundary only retires
+    # the interval under the ingest lock (one rebind into shadow
+    # banks); draining, import landing, and the flush program run
+    # outside it, so admit/ingest never stalls behind the flush
+    # executable or materialize. Off = legacy drain-under-lock
+    # ordering (the mesh engine always uses legacy).
+    tpu_flush_double_buffer: bool = True
 
     # --- native C++ ingest bridge (native/vtpu_ingest.cpp) ---
     # When on, UDP DogStatsD ingest (readers + parse + key interning +
@@ -510,6 +527,10 @@ def _validate(cfg: Config) -> None:
     if cfg.tpu_flush_fetch not in ("sync", "staged", "host", "async"):
         raise ValueError(
             "tpu_flush_fetch must be one of sync/staged/host/async")
+    if not (0.0 < cfg.tpu_flush_incremental_threshold <= 1.0):
+        raise ValueError(
+            "tpu_flush_incremental_threshold must be in (0, 1]: the "
+            "dirty fraction above which the full flush program runs")
     # t-digest centroid capacity is ~2*compression (fixed 100), padded to
     # 128 lanes. A buffer shallower than that makes the global import
     # path pay ceil(C/B) compress dispatches per landing round —

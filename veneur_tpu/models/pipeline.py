@@ -156,13 +156,14 @@ def _ingest_executables(device, heng, seng):
     }
 
 
-@functools.lru_cache(maxsize=None)
-def _flush_executable(device, heng, seng, fwd_out, agg_emit, pallas_ok,
-                      donate=True, compact=False):
-    """The fused interval-flush program: compress + quantiles + the
-    configured aggregates + counter/gauge/set finalization in ONE XLA
-    call, returning only the compact arrays the host assembly needs
-    (plus raw sketch state when this engine forwards upstream).
+def _flush_program_body(heng, seng, fwd_out, agg_emit, pallas_ok,
+                        compact):
+    """The flush computation itself — compress + quantiles + the
+    configured aggregates + counter/gauge/set finalization — as a
+    jit-composable closure over (hb, cb, gb, sb, qs). Shared by the
+    full-bank executable (_flush_executable) and the incremental
+    dirty-slot executable (_inc_flush_executable), so both paths run
+    the IDENTICAL math and differ only in which rows they see.
 
     Output contract (all f32 unless noted):
       q        [K, P']      quantile matrix (P' includes a median column
@@ -192,8 +193,6 @@ def _flush_executable(device, heng, seng, fwd_out, agg_emit, pallas_ok,
                             fetch_flush_outputs) — emitting them costs
                             device memory, not wire
     """
-    sds = jax.sharding.SingleDeviceSharding(device)
-
     def program(hb, cb, gb, sb, qs):
         hb = heng.compress_impl(hb)
         agg = heng.aggregates_impl(hb)
@@ -257,6 +256,22 @@ def _flush_executable(device, heng, seng, fwd_out, agg_emit, pallas_ok,
             out["s_regs"] = sb.registers
         return out
 
+    return program
+
+
+@functools.lru_cache(maxsize=None)
+def _flush_executable(device, heng, seng, fwd_out, agg_emit, pallas_ok,
+                      donate=True, compact=False):
+    """The fused interval-flush program over the FULL banks: ONE XLA
+    call over every slot (see _flush_program_body for the output
+    contract). The incremental dirty-slot path (_inc_flush_executable)
+    is the serving default when most slots are cold; this full build
+    remains the oracle, the warmup/baseline program, and the serving
+    path above the dirty-fraction threshold."""
+    sds = jax.sharding.SingleDeviceSharding(device)
+    program = _flush_program_body(heng, seng, fwd_out, agg_emit,
+                                  pallas_ok, compact)
+
     # donate=False builds a variant safe to dispatch repeatedly on the
     # same banks (bench.py's chained exec estimator); serving always
     # donates. Donation audit (ISSUE 3 satellite): an argument is
@@ -299,6 +314,109 @@ def _flush_executable(device, heng, seng, fwd_out, agg_emit, pallas_ok,
         return jitted(core, bufs, cb, gb, sb, qs)
 
     return call
+
+
+def _inc_bucket(n: int, num_slots: int) -> int:
+    """Padded work-set width for `n` dirty slots of a `num_slots` bank:
+    powers of two up to 4096 (one executable per bucket, compiled once
+    and cached), then 4096-aligned (tight enough that the exec-time
+    ratio tracks the touched ratio at 100k — a pure power-of-two ladder
+    would pad 10% dirty to 16% of the bank). Never below 64 (tiny
+    buckets would mint executables per handful of slots) and never
+    above the bank itself."""
+    b = 64
+    while b < n and b < 4096:
+        b *= 2
+    if n > 4096:
+        b = -(-n // 4096) * 4096
+    return min(b, num_slots)
+
+
+def pad_dirty_ids(ids, num_slots: int):
+    """One bank's dirty-id vector padded to its _inc_bucket width with
+    index 0 (padding rows duplicate row 0's compute; consumers read
+    only the true-D prefix) — the EXACT work-set shape
+    _flush_device_incremental dispatches, shared with bench_suite's
+    exec-only A/B so the bench can never drift to a stale shape."""
+    b = _inc_bucket(max(ids.size, 1), num_slots)
+    pad = np.zeros(b, np.int32)
+    pad[:ids.size] = ids
+    return pad
+
+
+@functools.lru_cache(maxsize=None)
+def _inc_flush_executable(device, heng, seng, fwd_out, agg_emit,
+                          pallas_ok, compact=False):
+    """The INCREMENTAL interval-flush program (ISSUE 11 tentpole):
+    gather only the dirty piles into a compact [D, ·] work set, run the
+    SAME flush body (_flush_program_body) over that slice, and return
+    compact [D, ·] outputs the host scatters over the cached
+    empty-bank baseline (_flush_device). Cold piles are fresh-init by
+    construction (the swap re-zeroes every row; restore re-marks
+    restored rows dirty), and the flush body maps a fresh row to the
+    baseline row bit-for-bit, so skipping cold rows is exact — the
+    oracle suite pins incremental == full per engine backend.
+
+    `ih/ic/ig/is_` are per-bank dirty-slot index vectors, padded to
+    their _inc_bucket width with index 0 (a padding row duplicates row
+    0's compute; the host scatter consumes only the true-D prefix, so
+    the duplicate work is dropped). One executable per (engine pair,
+    bucket-shape) combination — jit retraces per input shape under the
+    one cached wrapper.
+
+    No donation: the gathered outputs cannot alias the full-bank
+    inputs (different shapes), and requesting donation anyway would
+    re-introduce the "donated buffers were not usable" warning the
+    ISSUE 3 audit pins at zero."""
+    sds = jax.sharding.SingleDeviceSharding(device)
+    program = _flush_program_body(heng, seng, fwd_out, agg_emit,
+                                  pallas_ok, compact)
+
+    def gather(bank, idx):
+        return jax.tree_util.tree_map(lambda leaf: leaf[idx], bank)
+
+    def inc(hb, cb, gb, sb, qs, ih, ic, ig, is_):
+        return program(gather(hb, ih), gather(cb, ic), gather(gb, ig),
+                       gather(sb, is_), qs)
+
+    return jax.jit(inc, out_shardings=sds)
+
+
+@functools.lru_cache(maxsize=None)
+def _flush_baseline_cached(device, heng, seng, fwd_out, agg_emit,
+                           pallas_ok, compact, qs):
+    """Empty-flush baseline rows (see _flush_baseline_rows), cached at
+    module level so every engine with the same sketch pair + flush
+    config shares one K=1 compile. Treat the returned rows as
+    immutable."""
+    from ..ops import scalar as _scalar
+    body = _flush_program_body(heng, seng, fwd_out, agg_emit,
+                               pallas_ok, compact)
+    fresh = jax.device_put(
+        (heng.init(1), _scalar.init_counters(1),
+         _scalar.init_gauges(1), seng.init(1)), device)
+    host = fetch_flush_outputs(
+        jax.jit(body)(*fresh, np.asarray(qs, np.float32)), "sync")
+    host = decompact_flush_host(host, agg_emit)
+    if "s_est" in host or "s_counts" in host:
+        seng.estimate_finalize(host)
+    return {k: np.asarray(v)[0]
+            for k, v in host.items() if np.asarray(v).ndim}
+
+
+def _out_bank_kind(key: str) -> int:
+    """Which bank's dirty-index vector an incremental output key is
+    scattered under: 0=histogram, 1=counter, 2=gauge, 3=set. Keys are
+    grouped by prefix — h_*/q/agg* and the 2Sum lo_* terms ride the
+    histogram bank, c_* the counter bank, g_* the gauge bank, s_* the
+    set bank."""
+    if key.startswith("c_"):
+        return 1
+    if key.startswith("g_"):
+        return 2
+    if key.startswith("s_"):
+        return 3
+    return 0
 
 
 def stage_copy_executable(sharding=None):
@@ -455,6 +573,27 @@ class EngineConfig:
     # Worth it only on transport-constrained rigs (the ~20 MB/s tunnel);
     # directly-attached TPUs move the full payload in well under 1 ms.
     flush_fetch_f16: bool = False
+    # Incremental dirty-slot flush (ISSUE 11): the flush program
+    # consumes the SAME dirty-slot bitmap the delta checkpoints mark at
+    # every device-landing site, gathers only touched piles into a
+    # compact [D, ·] work set, and scatters results over the cached
+    # empty-bank baseline — cold piles keep their (fresh-init) state
+    # and materialized rows verbatim, bit-identical to the full
+    # program by construction. Above `flush_incremental_threshold`
+    # dirty fraction on the histogram bank the full program runs
+    # instead (a near-full gather costs more than it saves).
+    flush_incremental: bool = True
+    flush_incremental_threshold: float = 0.75
+    # Double-buffered flush (ISSUE 11): the tick boundary only RETIRES
+    # the interval under the ingest lock (stage buffers, staged
+    # imports, banks, dirty bitmaps swap against fresh shadows in one
+    # rebind); draining the retired stages, landing the retired
+    # imports, and the flush program itself all run outside the lock —
+    # admit/ingest never stalls behind them. Off = the legacy ordering
+    # (drain+land under the lock before the swap; the mesh engine
+    # always uses it — its landing paths write sharded banks in
+    # place).
+    flush_double_buffer: bool = True
 
 
 @dataclass
@@ -532,6 +671,12 @@ class _Stage:
 
 
 class AggregationEngine:
+    # Subclass gates for the ISSUE 11 flush paths: the mesh engine owns
+    # sharded banks (no per-slot bitmaps, landing paths write banks in
+    # place) and turns both off in its constructor.
+    _incremental_capable = True
+    _double_buffer_capable = True
+
     def _setup_device(self):
         """Build the device-side state: committed banks plus the shared
         fresh-banks and ingest executables (see the factory comments
@@ -542,6 +687,8 @@ class AggregationEngine:
         self._fresh_fn = _fresh_banks_executable(
             self._device, self._heng, self._seng, cfg.histogram_slots,
             cfg.counter_slots, cfg.gauge_slots, cfg.set_slots)
+        # vlint: disable=DS01 reason=initial fresh-bank build, not a
+        # data landing — every row is exactly fresh init (zero dirty)
         (self.histo_bank, self.counter_bank,
          self.gauge_bank, self.set_bank) = self._fresh_fn()
         self._kern = _ingest_executables(self._device, self._heng,
@@ -583,6 +730,11 @@ class AggregationEngine:
             raise ValueError(
                 f"flush_fetch={self.cfg.flush_fetch!r}: must be "
                 "sync/staged/host/async")
+        if not (0.0 < self.cfg.flush_incremental_threshold <= 1.0):
+            raise ValueError(
+                "flush_incremental_threshold must be in (0, 1]: it is "
+                "the dirty fraction above which the full flush program "
+                f"runs, got {self.cfg.flush_incremental_threshold!r}")
         # One ingest thread owns process(); flush() may run from another
         # thread. The lock is the Worker.Flush mutex-swap equivalent:
         # ingest holds it per item; flush holds it ONLY across
@@ -650,17 +802,36 @@ class AggregationEngine:
         self._pres_bound = 4 * (cfg.histogram_slots + cfg.counter_slots
                                 + cfg.gauge_slots + cfg.set_slots)
         self.samples_processed = 0
-        # Engine checkpointing (durability/ ISSUE 9): dirty-slot
-        # bitmaps per bank, armed by enable_dirty_tracking (the Server
-        # does it when durability_engine_snapshot is on). None = zero
-        # tracking work — the regression-pinned default. Marked at
-        # every DEVICE LANDING site (scatter/merge dispatch), reset at
-        # the flush swap, so at any instant `fresh init + dirty rows`
-        # is exactly the bank state — what makes a flush-boundary
-        # delta checkpoint self-contained. last_import_op is the
-        # applied-op watermark recovery filters the replay log by.
+        # Dirty-slot bitmaps per bank, with TWO consumers (ISSUE 9 +
+        # ISSUE 11): flush-boundary delta checkpoints serialize only
+        # dirty rows, and the incremental flush program compresses only
+        # dirty piles. Marked at every DEVICE LANDING site (scatter/
+        # merge dispatch — machine-checked by vlint DS01), retired at
+        # the flush swap (the retiring interval's bitmap travels with
+        # its bank snapshot; a FRESH zero bitmap replaces it in the
+        # same rebind), so at any instant `fresh init + dirty rows` is
+        # exactly the live bank state — what keeps delta checkpoints
+        # self-contained with the flush as a second consumer.
+        # Armed by default for the incremental flush; None only when
+        # flush_incremental is off AND enable_dirty_tracking was never
+        # called (then landing sites cost one attribute load).
+        # last_import_op is the applied-op watermark recovery filters
+        # the replay log by.
         self._dirty = None
         self._delta_threshold = 0.5
+        self._use_incremental = (cfg.flush_incremental
+                                 and self._incremental_capable)
+        self._use_double_buffer = (cfg.flush_double_buffer
+                                   and self._double_buffer_capable)
+        if self._use_incremental:
+            self._dirty = [
+                np.zeros(getattr(self, attr).num_slots, bool)
+                for _kind, attr, _ki in self._bank_table()]
+        # per-output-key baseline rows of an EMPTY flush (what every
+        # cold pile materializes to) — computed lazily on a 1-slot
+        # fresh bank set (engine-pair-shaped, slot-count-independent)
+        self._flush_baseline = None
+        self._last_flush_info = {"path": "full"}
         self.last_import_op = 0
         # Overload defense (ingest/admission.py): attached by the
         # Server via attach_admission; None = every key mints freely
@@ -845,10 +1016,18 @@ class AggregationEngine:
         self._ingest_batch(slots, count, mark, apply)
 
     def _add_histos(self, slots, values, weights):
-        """Land one histogram batch, sidestepping the hot-slot worst
-        case: add_batch's while-loop pays a full-bank [K, C+B] sort per
-        buffer-depth's worth of samples landing on ONE slot, so a batch
-        where max-per-slot is 8192/B=32x over depth costs 32 sorts. When
+        """Live-bank histogram landing (ingest path; mesh overrides
+        this wholesale — its landing routes the sharded ingest)."""
+        self.histo_bank = self._land_histos(
+            self.histo_bank, self._dirty, slots, values, weights)
+
+    def _land_histos(self, bank, dirty, slots, values, weights):
+        """Land one histogram batch into `bank` (live or a retired
+        double-buffer snapshot — the caller owns the rebind), marking
+        `dirty`, sidestepping the hot-slot worst case: add_batch's
+        while-loop pays a full-bank [K, C+B] sort per buffer-depth's
+        worth of samples landing on ONE slot, so a batch where
+        max-per-slot is 8192/B=32x over depth costs 32 sorts. When
         a batch overfills any slot, pre-cluster the hot slots' samples
         on host to <= B weighted points each (numpy sort + bucketed
         segment means — the same two-level scheme the digest itself
@@ -856,11 +1035,11 @@ class AggregationEngine:
         granularity), then land everything with ONE compress +
         merge_centroids + exact merge_scalars."""
         slots = np.asarray(slots)
-        B = self.histo_bank.buf_size
+        B = bank.buf_size
         valid = slots >= 0
         vs = slots[valid]
-        if self._dirty is not None and vs.size:
-            self._dirty[0][vs] = True
+        if dirty is not None and vs.size:
+            dirty[0][vs] = True
         # Hot-slot detection, cheapest-first (this runs on EVERY pump
         # batch): a batch with <= B valid rows cannot overfill any slot,
         # so skip counting entirely. Otherwise bincount — one O(n + max)
@@ -869,9 +1048,7 @@ class AggregationEngine:
         # scan a multi-MB count array per batch); there np.unique's
         # O(n log n) on the small batch is the cheaper form.
         if vs.size <= B:
-            self.histo_bank = self._kern["histo"](
-                self.histo_bank, slots, values, weights)
-            return
+            return self._kern["histo"](bank, slots, values, weights)
         if vs.max() > 16 * vs.size:
             uniq, cnt = np.unique(vs, return_counts=True)
             hot_ids = uniq[cnt > B]
@@ -879,16 +1056,13 @@ class AggregationEngine:
             cnt = np.bincount(vs, minlength=1)
             hot_ids = np.nonzero(cnt > B)[0]
         if hot_ids.size == 0:
-            self.histo_bank = self._kern["histo"](
-                self.histo_bank, slots, values, weights)
-            return
+            return self._kern["histo"](bank, slots, values, weights)
         values = np.asarray(values)
         weights = np.asarray(weights)
         hot = set(hot_ids.tolist())
         hot_m = np.isin(slots, list(hot)) & valid
         cold_slots = np.where(hot_m, -1, slots).astype(np.int32)
-        self.histo_bank = self._kern["histo"](
-            self.histo_bank, cold_slots, values, weights)
+        bank = self._kern["histo"](bank, cold_slots, values, weights)
 
         out_s, out_m, out_w = [], [], []
         sc_s, sc_min, sc_max, sc_sum, sc_cnt, sc_rcp = \
@@ -927,20 +1101,17 @@ class AggregationEngine:
         spad[:nh] = np.asarray(sc_s, np.int32)
         f = lambda a: np.pad(np.asarray(a, np.float32), (0, swidth - nh))
         # compress first so merge_centroids has a full buffer of headroom
-        self.histo_bank = self._kern["compress"](self.histo_bank)
-        self.histo_bank = self._kern["merge_centroids"](
-            self.histo_bank, pad_s, pad_m, pad_w)
-        self.histo_bank = self._kern["merge_scalars"](
-            self.histo_bank, spad, f(sc_min), f(sc_max), f(sc_sum),
+        bank = self._kern["compress"](bank)
+        bank = self._kern["merge_centroids"](bank, pad_s, pad_m, pad_w)
+        return self._kern["merge_scalars"](
+            bank, spad, f(sc_min), f(sc_max), f(sc_sum),
             f(sc_cnt), f(sc_rcp))
 
     def ingest_counter_batch(self, slots, values, weights, count=None,
                              mark=None):
         def apply(n):
-            if self._dirty is not None:
-                self._mark_dirty(1, slots)
-            self.counter_bank = self._kern["counter"](
-                self.counter_bank, slots, values, weights)
+            self.counter_bank = self._land_counters(
+                self.counter_bank, self._dirty, slots, values, weights)
         self._ingest_batch(slots, count, mark, apply)
 
     def ingest_gauge_batch(self, slots, values, count=None, mark=None):
@@ -950,21 +1121,17 @@ class AggregationEngine:
         # pre-flush sample can never outrank a newer post-flush one and
         # the counter cannot wrap within an interval.
         def apply(n):
-            if self._dirty is not None:
-                self._mark_dirty(2, slots)
             seqs = np.arange(1, len(slots) + 1, dtype=np.int32) \
                 + self._gauge_seq
             self._gauge_seq += n
-            self.gauge_bank = self._kern["gauge"](
-                self.gauge_bank, slots, values, seqs)
+            self.gauge_bank = self._land_gauges(
+                self.gauge_bank, self._dirty, slots, values, seqs)
         self._ingest_batch(slots, count, mark, apply)
 
     def ingest_set_batch(self, slots, reg_idx, rho, count=None, mark=None):
         def apply(n):
-            if self._dirty is not None:
-                self._mark_dirty(3, slots)
-            self.set_bank = self._kern["set"](
-                self.set_bank, slots, reg_idx, rho)
+            self.set_bank = self._land_sets(
+                self.set_bank, self._dirty, slots, reg_idx, rho)
         self._ingest_batch(slots, count, mark, apply)
 
     def process_event(self, ev):
@@ -984,24 +1151,41 @@ class AggregationEngine:
 
     def _dispatch_counters(self):
         a = self._counter_stage.drain()
-        if self._dirty is not None:
-            self._mark_dirty(1, a["slots"])
-        self.counter_bank = self._kern["counter"](
-            self.counter_bank, a["slots"], a["values"], a["weights"])
+        self.counter_bank = self._land_counters(
+            self.counter_bank, self._dirty, a["slots"], a["values"],
+            a["weights"])
 
     def _dispatch_gauges(self):
         a = self._gauge_stage.drain()
-        if self._dirty is not None:
-            self._mark_dirty(2, a["slots"])
-        self.gauge_bank = self._kern["gauge"](
-            self.gauge_bank, a["slots"], a["values"], a["seqs"])
+        self.gauge_bank = self._land_gauges(
+            self.gauge_bank, self._dirty, a["slots"], a["values"],
+            a["seqs"])
 
     def _dispatch_sets(self):
         a = self._set_stage.drain()
-        if self._dirty is not None:
-            self._mark_dirty(3, a["slots"])
-        self.set_bank = self._kern["set"](
-            self.set_bank, a["slots"], a["reg_idx"], a["rho"])
+        self.set_bank = self._land_sets(
+            self.set_bank, self._dirty, a["slots"], a["reg_idx"],
+            a["rho"])
+
+    # ---- scalar/set landing cores: take and return the bank, mark
+    # the PASSED bitmap — shared by the live ingest path (live banks +
+    # live bitmap) and the double-buffered flush's retired landing
+    # (retired banks + retired bitmap).
+
+    def _land_counters(self, bank, dirty, slots, values, weights):
+        if dirty is not None:
+            self._mark_dirty_into(dirty, 1, slots)
+        return self._kern["counter"](bank, slots, values, weights)
+
+    def _land_gauges(self, bank, dirty, slots, values, seqs):
+        if dirty is not None:
+            self._mark_dirty_into(dirty, 2, slots)
+        return self._kern["gauge"](bank, slots, values, seqs)
+
+    def _land_sets(self, bank, dirty, slots, reg_idx, rho):
+        if dirty is not None:
+            self._mark_dirty_into(dirty, 3, slots)
+        return self._kern["set"](bank, slots, reg_idx, rho)
 
     def drain_all(self):
         for st, fn in ((self._histo_stage, self._dispatch_histos),
@@ -1035,6 +1219,9 @@ class AggregationEngine:
         with self.lock:
             # hot-slot sidestep programs, at their (fixed) shapes
             width, swidth = self._hot_widths()
+            # vlint: disable=DS01 reason=warmup compiles against
+            # all-padding batches (slot -1 rows are dropped by the
+            # kernels) — no live data lands, nothing to mark
             self.histo_bank = self._kern["compress"](self.histo_bank)
             self.histo_bank = self._kern["merge_centroids"](
                 self.histo_bank, np.full(width, -1, np.int32),
@@ -1046,6 +1233,16 @@ class AggregationEngine:
         # Run the full configured flush path (program + staging/fetch
         # mode) so flush 0 hits only warm executables.
         self._flush_device(self._fresh_fn())
+        if self._use_incremental:
+            # the incremental path too: build the empty-flush baseline
+            # and compile the smallest-bucket incremental program (one
+            # dirty slot per bank — flush 0's common shape; bigger
+            # dirty sets compile their bucket inline, like the
+            # cluster_rows width ladder)
+            warm_dirty = [np.zeros_like(d) for d in self._dirty]
+            for d in warm_dirty:
+                d[0] = True
+            self._flush_device(self._fresh_fn(), dirty=warm_dirty)
         jax.block_until_ready(self.histo_bank)
 
     def warm_ingest_kernels(self, b: int):
@@ -1058,6 +1255,9 @@ class AggregationEngine:
         zi = np.zeros(b, np.int32)
         zu = np.zeros(b, np.uint8)
         with self.lock:
+            # vlint: disable=DS01 reason=all-padding warmup batches
+            # (slot -1 rows dropped by the kernels) — no live data
+            # lands, nothing to mark
             self.histo_bank = self._kern["histo"](
                 self.histo_bank, pad, zf, zf)
             self.counter_bank = self._kern["counter"](
@@ -1174,54 +1374,68 @@ class AggregationEngine:
         return rerouted, rejected
 
     def _flush_import_sets(self):
-        if not self._import_sets:
-            return
         items, self._import_sets = self._import_sets, []
+        self.set_bank = self._land_import_sets(self.set_bank, items,
+                                               self._dirty)
+
+    def _land_import_sets(self, bank, items, dirty):
+        if not items:
+            return bank
         slots = np.array([s for s, _ in items], np.int32)
-        if self._dirty is not None:
-            self._mark_dirty(3, slots)
-        self.set_bank = jax.device_put(self._seng.merge_rows(
-            self.set_bank, slots,
-            np.stack([r for _, r in items])), self._device)
+        if dirty is not None:
+            self._mark_dirty_into(dirty, 3, slots)
+        return jax.device_put(self._seng.merge_rows(
+            bank, slots, np.stack([r for _, r in items])), self._device)
 
     def _flush_import_scalars(self):
-        if self._import_counter_acc:
-            acc, self._import_counter_acc = self._import_counter_acc, {}
-            slots = np.fromiter(acc.keys(), np.int32, len(acc))
-            if self._dirty is not None:
-                self._mark_dirty(1, slots)
-            self.counter_bank = jax.device_put(scalar.counter_merge(
-                self.counter_bank, slots,
-                np.fromiter(acc.values(), np.float32, len(acc))),
-                self._device)
-        if self._import_gauge_acc:
-            acc, self._import_gauge_acc = self._import_gauge_acc, {}
-            slots = np.fromiter(acc.keys(), np.int32, len(acc))
-            if self._dirty is not None:
-                self._mark_dirty(2, slots)
-            seqs = np.arange(len(acc), dtype=np.int32) + self._gauge_seq + 1
-            self._gauge_seq += len(acc)
-            self.gauge_bank = jax.device_put(scalar.gauge_set(
-                self.gauge_bank, slots,
-                np.fromiter(acc.values(), np.float32, len(acc)), seqs),
-                self._device)
+        counters, self._import_counter_acc = self._import_counter_acc, {}
+        gauges, self._import_gauge_acc = self._import_gauge_acc, {}
+        (self.counter_bank, self.gauge_bank,
+         self._gauge_seq) = self._land_import_scalars(
+            self.counter_bank, self.gauge_bank, counters, gauges,
+            self._dirty, self._gauge_seq)
+
+    def _land_import_scalars(self, cbank, gbank, counters, gauges,
+                             dirty, gauge_seq):
+        if counters:
+            slots = np.fromiter(counters.keys(), np.int32, len(counters))
+            if dirty is not None:
+                self._mark_dirty_into(dirty, 1, slots)
+            cbank = jax.device_put(scalar.counter_merge(
+                cbank, slots,
+                np.fromiter(counters.values(), np.float32,
+                            len(counters))), self._device)
+        if gauges:
+            slots = np.fromiter(gauges.keys(), np.int32, len(gauges))
+            if dirty is not None:
+                self._mark_dirty_into(dirty, 2, slots)
+            seqs = np.arange(len(gauges), dtype=np.int32) + gauge_seq + 1
+            gauge_seq += len(gauges)
+            gbank = jax.device_put(scalar.gauge_set(
+                gbank, slots,
+                np.fromiter(gauges.values(), np.float32, len(gauges)),
+                seqs), self._device)
+        return cbank, gbank, gauge_seq
 
     def _flush_import_centroids(self):
-        """Land staged foreign digests under the engine's import
-        strategy: "cluster" (t-digest — precluster each slot's pile to
-        <= C centroids with ONE batched cluster_rows program, then one
-        merge + one compress) or "direct" (compactor engines — the
-        items re-insert as weighted points in fixed-width batches; the
-        engine's own compaction bounds memory, no preclustering)."""
-        if not self._import_centroids:
-            return
         items = self._import_centroids
         self._import_centroids = []
         self._import_centroid_total = 0
+        self.histo_bank = self._land_import_centroids(
+            self.histo_bank, items, self._dirty)
+
+    def _land_import_centroids(self, bank, items, dirty):
+        """Land staged foreign digests into `bank` under the engine's
+        import strategy: "cluster" (t-digest — precluster each slot's
+        pile to <= C centroids with ONE batched cluster_rows program,
+        then one merge + one compress) or "direct" (compactor engines —
+        the items re-insert as weighted points in fixed-width batches;
+        the engine's own compaction bounds memory, no preclustering)."""
+        if not items:
+            return bank
         if self._heng.import_strategy == "direct":
-            self._land_imports_direct(items)
-            return
-        C = self.histo_bank.num_centroids
+            return self._land_imports_direct(bank, items, dirty)
+        C = bank.num_centroids
 
         by_slot: dict[int, list] = {}
         for s, means, weights, *_ in items:
@@ -1293,8 +1507,8 @@ class AggregationEngine:
             trusted.update(oversized)
 
         slot_ids = np.fromiter(by_slot.keys(), np.int32, len(by_slot))
-        if self._dirty is not None:
-            self._mark_dirty(0, slot_ids)
+        if dirty is not None:
+            self._mark_dirty_into(dirty, 0, slot_ids)
         widths = [sum(len(m) for m, _ in piles)
                   for piles in by_slot.values()]
         W = max(128, int(np.ceil(max(widths) / 128.0) * 128))
@@ -1314,20 +1528,20 @@ class AggregationEngine:
         # land the clustered centroids; merge_centroids drops on buffer
         # overflow, so chunk the C columns to the buffer depth (one
         # iteration in the default config where B >= C)
-        B = self.histo_bank.buf_size
+        B = bank.buf_size
         for c0 in range(0, C, B):
             chunk = slice(c0, min(C, c0 + B))
             width = chunk.stop - chunk.start
-            self.histo_bank = self._heng.compress(self.histo_bank)
+            bank = self._heng.compress(bank)
             rows = np.repeat(slot_ids, width)
-            self.histo_bank = self._heng.merge_centroids(
-                self.histo_bank, rows, cmeans[:, chunk].reshape(-1),
+            bank = self._heng.merge_centroids(
+                bank, rows, cmeans[:, chunk].reshape(-1),
                 cwts[:, chunk].reshape(-1))
-        self.histo_bank = self._heng.compress(self.histo_bank)
+        bank = self._heng.compress(bank)
 
         sl = np.array([it[0] for it in items], np.int32)
-        self.histo_bank = self._heng.merge_scalars(
-            self.histo_bank, sl,
+        bank = self._heng.merge_scalars(
+            bank, sl,
             np.array([it[3] for it in items], np.float32),
             np.array([it[4] for it in items], np.float32),
             np.array([it[5] for it in items], np.float32),
@@ -1336,13 +1550,13 @@ class AggregationEngine:
         # the merge chain above ran through plain jits whose outputs are
         # uncommitted; recommit so the ingest kernels and the flush
         # program stay on their committed (fast) executables
-        self.histo_bank = jax.device_put(self.histo_bank, self._device)
+        return jax.device_put(bank, self._device)
 
     # fixed flat-batch width for the direct import landing: one program
     # shape however many centroids an interval staged
     _DIRECT_LAND_WIDTH = 4096
 
-    def _land_imports_direct(self, items):
+    def _land_imports_direct(self, bank, items, dirty):
         """The "direct" import strategy (compactor engines): re-insert
         every forwarded weighted point through the engine's own
         merge_centroids — its internal compaction bounds memory, so no
@@ -1355,8 +1569,8 @@ class AggregationEngine:
             np.asarray(it[1], np.float32) for it in items])
         wts = np.concatenate([
             np.asarray(it[2], np.float32) for it in items])
-        if self._dirty is not None:
-            self._mark_dirty(0, np.unique(slots))
+        if dirty is not None:
+            self._mark_dirty_into(dirty, 0, np.unique(slots))
         for i in range(0, len(slots), W):
             seg = slice(i, min(len(slots), i + W))
             n = seg.stop - seg.start
@@ -1366,17 +1580,16 @@ class AggregationEngine:
             ps[:n] = slots[seg]
             pm[:n] = means[seg]
             pw[:n] = wts[seg]
-            self.histo_bank = self._heng.merge_centroids(
-                self.histo_bank, ps, pm, pw)
+            bank = self._heng.merge_centroids(bank, ps, pm, pw)
         sl = np.array([it[0] for it in items], np.int32)
-        self.histo_bank = self._heng.merge_scalars(
-            self.histo_bank, sl,
+        bank = self._heng.merge_scalars(
+            bank, sl,
             np.array([it[3] for it in items], np.float32),
             np.array([it[4] for it in items], np.float32),
             np.array([it[5] for it in items], np.float32),
             np.array([it[6] for it in items], np.float32),
             np.array([it[7] for it in items], np.float32))
-        self.histo_bank = jax.device_put(self.histo_bank, self._device)
+        return jax.device_put(bank, self._device)
 
     # ---------------- flush ----------------
 
@@ -1384,25 +1597,47 @@ class AggregationEngine:
         """Under the lock: return the interval's bank snapshot and hand
         ingest fresh banks — the Worker.Flush swap, ONE async dispatch
         of the committed-output zeros program. Overridden by the mesh
-        engine (its reset donates the sharded banks)."""
+        engine (its reset donates the sharded banks). Dirty-bitmap
+        retirement happens in _retire_dirty (the caller), not here —
+        the retiring bitmap must travel WITH this snapshot to its
+        consumer (the incremental flush), while the fresh banks get a
+        fresh zero bitmap in the same critical section."""
         snap = (self.histo_bank, self.counter_bank,
                 self.gauge_bank, self.set_bank)
+        # vlint: disable=DS01 reason=the fresh-bank swap, not a data
+        # landing — the caller pairs it with _retire_dirty, which
+        # installs a zero bitmap matching these all-fresh rows
         (self.histo_bank, self.counter_bank,
          self.gauge_bank, self.set_bank) = self._fresh_fn()
-        if self._dirty is not None:
-            # the swap re-zeroed every row: from here `fresh init +
-            # dirty rows` describes the new banks exactly
-            for d in self._dirty:
-                d[:] = False
         return snap
 
-    def _flush_device(self, snap, phases=None) -> dict:
-        """Run the fused flush program on the snapshot and fetch the
-        compact host arrays: ONE program dispatch + ONE device_get (on a
+    def _retire_dirty(self):
+        """Under the lock, with the bank swap: hand the retiring
+        interval's dirty bitmaps to the flush and install fresh zero
+        bitmaps for the new banks. The swap re-zeroed every row, so
+        `fresh init + dirty rows` describes the new banks exactly —
+        the invariant BOTH consumers (delta checkpoints, incremental
+        flush) rely on; a checkpoint taken after this tick sees only
+        post-swap marks, never the flushed interval's."""
+        retired = self._dirty
+        if retired is not None:
+            self._dirty = [np.zeros_like(d) for d in retired]
+        return retired
+
+    def _flush_device(self, snap, phases=None, dirty=None) -> dict:
+        """Run the flush program on the snapshot and fetch the compact
+        host arrays: ONE program dispatch + ONE device_get (on a
         tunneled TPU backend the transfer IS the flush cost; the program
         itself is ~0.2ms at 100k slots, TPU_EVIDENCE_r04.md §1).
         `flush_fetch` picks how the fetch is performed (see EngineConfig).
         Overridden by the mesh engine.
+
+        `dirty` is the retired interval's dirty-slot bitmap set: when
+        given (and incremental flush is on), only the touched piles
+        run through the device — the ISSUE 11 tentpole
+        (_flush_device_incremental); above the dirty-fraction
+        threshold, or with dirty=None (warmup, bench harnesses, mesh),
+        the full program runs.
 
         `phases` (flight-recorder stamp list, appended in place) splits
         the merge into dispatch / device exec / fetch — but ONLY under
@@ -1411,6 +1646,11 @@ class AggregationEngine:
         serving executable exactly like an eager device_get (the reason
         the staged/host/async modes exist), so those modes record one
         combined `device` phase instead of paying a second sync."""
+        if dirty is not None and self._use_incremental:
+            host = self._flush_device_incremental(snap, phases, dirty)
+            if host is not None:
+                return host
+        self._last_flush_info = {"path": "full"}
         hb, cb, gb, sb = snap
         if phases is None:
             return self._fetch_flush(
@@ -1418,6 +1658,12 @@ class AggregationEngine:
         t0 = time.monotonic_ns()
         out = self._flush_exec(hb, cb, gb, sb, self._qs)
         t1 = time.monotonic_ns()
+        return self._timed_fetch(out, t0, t1, phases)
+
+    def _timed_fetch(self, out, t0, t1, phases):
+        """Fetch flush outputs with the device.dispatch/exec/fetch (or
+        combined `device`) phase stamps — shared by the full and
+        incremental dispatch paths."""
         if self.cfg.flush_fetch == "sync":
             jax.block_until_ready(out)
             t2 = time.monotonic_ns()
@@ -1433,6 +1679,104 @@ class AggregationEngine:
             phases.append(("device", t1, t3))
         return host
 
+    def _flush_baseline_rows(self) -> dict:
+        """Per-output-key baseline row of an EMPTY flush — what every
+        cold pile materializes to. Computed ONCE per (engine pair,
+        flush config) on a 1-slot fresh bank set through the same
+        program body + fetch post-processing as the serving path
+        (slot-count-independent: fresh rows are identical), shared
+        process-wide via the module cache. The incremental flush
+        scatters dirty-row outputs over these rows; bit-identity to
+        the full program holds because the flush body maps a fresh
+        bank row to exactly this row (pinned by the oracle suite)."""
+        if self._flush_baseline is None:
+            self._flush_baseline = _flush_baseline_cached(
+                self._device, self._heng, self._seng, self._fwd_out,
+                tuple(self._agg_emit),
+                self._device.platform in ("tpu", "axon"),
+                self.cfg.flush_fetch_f16,
+                tuple(float(q) for q in self._qs))
+        return self._flush_baseline
+
+    def _flush_device_incremental(self, snap, phases, dirty):
+        """The incremental dirty-slot flush (ISSUE 11 tentpole):
+        gather only touched piles into a compact [D, ·] work set, run
+        the shared flush body over that slice, and scatter the compact
+        outputs over the cached empty-bank baseline on host — cold
+        piles keep their prior (fresh-init) compressed state and
+        materialized rows verbatim. Returns None to fall back to the
+        full program when the histogram bank's dirty fraction exceeds
+        flush_incremental_threshold (a near-full gather costs more
+        than it saves). Phase stamps: `gather` (host dirty-index
+        extraction + padding), the usual device phases over the
+        compact program, `scatter` (host baseline overlay)."""
+        t0 = time.monotonic_ns()
+        ids = [np.nonzero(d)[0].astype(np.int32) for d in dirty]
+        if ids[0].size > (self.cfg.flush_incremental_threshold
+                          * dirty[0].size):
+            return None
+        base = self._flush_baseline_rows()
+        self._last_flush_info = {
+            "path": "incremental",
+            "dirty": [int(i.size) for i in ids],
+            "piles": [int(d.size) for d in dirty],
+        }
+        if all(i.size == 0 for i in ids):
+            # an idle interval: every output IS the baseline — no
+            # device dispatch at all
+            host = self._scatter_host({}, ids, dirty, base)
+            t1 = time.monotonic_ns()
+            if phases is not None:
+                phases.append(("gather", t0, t1))
+            return host
+        hb, cb, gb, sb = snap
+        idx = [pad_dirty_ids(i, d.size) for d, i in zip(dirty, ids)]
+        self._last_flush_info["buckets"] = [len(p) for p in idx]
+        exec_ = _inc_flush_executable(
+            self._device, self._heng, self._seng, self._fwd_out,
+            tuple(self._agg_emit),
+            self._device.platform in ("tpu", "axon"),
+            compact=self.cfg.flush_fetch_f16)
+        t1 = time.monotonic_ns()
+        if phases is not None:
+            phases.append(("gather", t0, t1))
+        t2 = time.monotonic_ns()
+        out = exec_(hb, cb, gb, sb, self._qs, *idx)
+        t3 = time.monotonic_ns()
+        if phases is not None:
+            host_c = self._timed_fetch(out, t2, t3, phases)
+        else:
+            host_c = self._fetch_flush(out)
+        t4 = time.monotonic_ns()
+        host = self._scatter_host(host_c, ids, dirty, base)
+        t5 = time.monotonic_ns()
+        if phases is not None:
+            phases.append(("scatter", t4, t5))
+        return host
+
+    def _scatter_host(self, host_c, ids, dirty, base) -> dict:
+        """Rebuild the full-[K] flush-host contract from a compact
+        [D, ·] fetch: each per-slot output starts as its baseline row
+        broadcast over the bank and the dirty rows overlay it — the
+        assembly code downstream is one implementation for both
+        paths. Non-per-slot keys (the compact-mode sentinel scalars)
+        pass through."""
+        out = {}
+        for k, row in base.items():
+            kind = _out_bank_kind(k)
+            K = dirty[kind].size
+            v = host_c.get(k)
+            full = np.empty((K,) + row.shape, row.dtype)
+            full[...] = row
+            n = ids[kind].size
+            if v is not None and n:
+                full[ids[kind]] = np.asarray(v)[:n]
+            out[k] = full
+        for k, v in host_c.items():
+            if k not in out:
+                out[k] = np.asarray(v)
+        return out
+
     def _fetch_flush(self, out):
         """device_get under the configured flush_fetch mode (shared with
         the mesh engine's _flush_device)."""
@@ -1445,49 +1789,130 @@ class AggregationEngine:
             self._seng.estimate_finalize(host)
         return host
 
+    def _flush_bookkeeping(self) -> tuple:
+        """Under the lock, at the tick boundary: snapshot the active
+        key sets and per-interval counters, reset them, and advance
+        the interner intervals — shared by both flush orderings."""
+        active = {
+            "histo": self.histo_keys.active_items(),
+            "counter": self.counter_keys.active_items(),
+            "gauge": self.gauge_keys.active_items(),
+            "set": self.set_keys.active_items(),
+        }
+        status, self._status = self._status, {}
+        stats_samples = self.samples_processed
+        self.samples_processed = 0
+        dropped = 0
+        for ki in (self.histo_keys, self.counter_keys,
+                   self.gauge_keys, self.set_keys):
+            dropped += ki.dropped_no_slot
+            ki.dropped_no_slot = 0  # per-interval, like `samples`
+        histo_key_count = len(self.histo_keys)
+        for ki in (self.histo_keys, self.counter_keys,
+                   self.gauge_keys, self.set_keys):
+            ki.advance_interval()
+        return active, status, stats_samples, dropped, histo_key_count
+
+    def _land_retired(self, snap, dirty, stages, imports,
+                      gauge_seq) -> tuple:
+        """Outside the lock (double-buffered flush): drain the retired
+        interval's stage buffers and land its staged imports into the
+        retired bank snapshot — the same work the legacy ordering does
+        under the lock, in the same order (stages first, then staged
+        imports), so both orderings produce bit-identical banks. Marks
+        go to the RETIRED bitmap: they belong to this flush's dirty
+        set, not the new banks' checkpoint bitmap. Safe lock-free: the
+        retired banks, stages, and import lists are no longer
+        reachable from the ingest path, and the shared ingest
+        executables are thread-safe to dispatch."""
+        hb, cb, gb, sb = snap
+        a = stages.get("histo")
+        if a is not None:
+            hb = self._land_histos(hb, dirty, a["slots"], a["values"],
+                                   a["weights"])
+        a = stages.get("counter")
+        if a is not None:
+            cb = self._land_counters(cb, dirty, a["slots"], a["values"],
+                                     a["weights"])
+        a = stages.get("gauge")
+        if a is not None:
+            gb = self._land_gauges(gb, dirty, a["slots"], a["values"],
+                                   a["seqs"])
+        a = stages.get("set")
+        if a is not None:
+            sb = self._land_sets(sb, dirty, a["slots"], a["reg_idx"],
+                                 a["rho"])
+        centroids, sets, counters, gauges = imports
+        hb = self._land_import_centroids(hb, centroids, dirty)
+        sb = self._land_import_sets(sb, sets, dirty)
+        cb, gb, _seq = self._land_import_scalars(
+            cb, gb, counters, gauges, dirty, gauge_seq)
+        return hb, cb, gb, sb
+
     def flush(self, timestamp: int | None = None) -> FlushResult:
         """The Server.Flush equivalent: snapshot banks, run the merge
         program, assemble InterMetrics + forward exports, reset state.
 
-        Only the drain+swap phase holds the ingest lock (the Worker.Flush
-        mutex-swap); the merge program and host assembly run on the
-        immutable snapshot while ingest continues into fresh banks."""
+        Double-buffered (the default): the lock is held ONLY across
+        the retire-and-swap — stage buffers, staged imports, banks and
+        dirty bitmaps swap against fresh shadows in one rebind
+        (`engine.swap` phase) — and ingest proceeds into the shadow
+        bank immediately; draining the retired stages, landing the
+        retired imports, the merge program, and host assembly all run
+        on the retired snapshot outside the lock. Legacy ordering
+        (flush_double_buffer off, and always on the mesh engine):
+        drain+land under the lock before the swap, as before."""
         ts = int(timestamp if timestamp is not None else time.time())
         cfg = self.cfg
         t_start = time.monotonic_ns()
-        with self.lock:
-            self.drain_all()
-            self._flush_import_centroids()
-            self._flush_import_sets()
-            self._flush_import_scalars()
-            snap = self._swap_banks()
-            self._gauge_seq = 0
-            active = {
-                "histo": self.histo_keys.active_items(),
-                "counter": self.counter_keys.active_items(),
-                "gauge": self.gauge_keys.active_items(),
-                "set": self.set_keys.active_items(),
-            }
-            status, self._status = self._status, {}
-            stats_samples = self.samples_processed
-            self.samples_processed = 0
-            dropped = 0
-            for ki in (self.histo_keys, self.counter_keys,
-                       self.gauge_keys, self.set_keys):
-                dropped += ki.dropped_no_slot
-                ki.dropped_no_slot = 0  # per-interval, like `samples`
-            histo_key_count = len(self.histo_keys)
-            for ki in (self.histo_keys, self.counter_keys,
-                       self.gauge_keys, self.set_keys):
-                ki.advance_interval()
+        if self._use_double_buffer:
+            with self.lock:
+                stages = {}
+                for name, st in (("histo", self._histo_stage),
+                                 ("counter", self._counter_stage),
+                                 ("gauge", self._gauge_stage),
+                                 ("set", self._set_stage)):
+                    if st.n:
+                        stages[name] = st.drain()
+                imports = (self._import_centroids, self._import_sets,
+                           self._import_counter_acc,
+                           self._import_gauge_acc)
+                self._import_centroids = []
+                self._import_centroid_total = 0
+                self._import_sets = []
+                self._import_counter_acc = {}
+                self._import_gauge_acc = {}
+                retired_seq = self._gauge_seq
+                self._gauge_seq = 0
+                snap = self._swap_banks()
+                dirty = self._retire_dirty()
+                (active, status, stats_samples, dropped,
+                 histo_key_count) = self._flush_bookkeeping()
+            t_swap = time.monotonic_ns()
+            # flight-recorder stamps: (name, t0_ns, t1_ns) on the
+            # shared monotonic_ns clock, returned in stats["phases"]
+            # so the server grafts them into the tick's phase tree
+            phases = [("swap", t_start, t_swap)]
+            snap = self._land_retired(snap, dirty, stages, imports,
+                                      retired_seq)
+            t_drain = time.monotonic_ns()
+            phases.append(("drain", t_swap, t_drain))
+        else:
+            with self.lock:
+                self.drain_all()
+                self._flush_import_centroids()
+                self._flush_import_sets()
+                self._flush_import_scalars()
+                snap = self._swap_banks()
+                dirty = self._retire_dirty()
+                self._gauge_seq = 0
+                (active, status, stats_samples, dropped,
+                 histo_key_count) = self._flush_bookkeeping()
+            t_swap = time.monotonic_ns()
+            phases = [("drain", t_start, t_swap)]
 
-        t_swap = time.monotonic_ns()
         fwd_out = self._fwd_out
-        # flight-recorder stamps: (name, t0_ns, t1_ns) on the shared
-        # monotonic_ns clock, returned in stats["phases"] so the server
-        # can graft them into the tick's phase tree with real edges
-        phases = [("drain", t_start, t_swap)]
-        host = self._flush_device(snap, phases=phases)
+        host = self._flush_device(snap, phases=phases, dirty=dirty)
         t_device = time.monotonic_ns()
 
         frame = MetricFrame(ts, cfg.hostname)
@@ -1642,10 +2067,17 @@ class AggregationEngine:
             "dropped_no_slot": dropped,
             # Flush phase durations (veneur's flush.*_duration_ns
             # self-metrics; flusher.go sym: Server.Flush spans).
+            # swap_ns is the LOCK-HELD window: under double buffering
+            # that is the retire-and-swap only; merge_ns then includes
+            # the out-of-lock retired drain + the device program.
             "swap_ns": t_swap - t_start,
             "merge_ns": t_device - t_swap,
             "assembly_ns": t_end - t_device,
             "phases": phases,
+            # which device path ran (full vs incremental + dirty/pile
+            # counts) — bench/test introspection, also what an
+            # operator correlates the gather/scatter phases against
+            "flush_path": dict(self._last_flush_info),
         }
         return FlushResult(frame=frame, export=export, stats=stats,
                            status_metrics=status_metrics)
@@ -1723,23 +2155,36 @@ class AggregationEngine:
         return drecords.BANK_LEAVES[kind]
 
     def enable_dirty_tracking(self, delta_threshold: float = 0.5):
-        """Arm per-bank dirty-slot bitmaps (the Server calls this when
-        durability_engine_snapshot is on; the ROADMAP's incremental-
-        compress perf item wants the same bitmap). `delta_threshold` is
-        the dirty fraction above which checkpoint_state fetches whole
-        leaves and slices on host instead of a device-side row gather
-        (a near-full gather costs more than the contiguous fetch)."""
+        """Arm per-bank dirty-slot bitmaps for the CHECKPOINT consumer
+        (the Server calls this when durability_engine_snapshot is on).
+        The incremental flush arms the same bitmaps in __init__ by
+        default; existing marks are preserved — rebuilding them here
+        would desync both consumers from rows already landed.
+        `delta_threshold` is the dirty fraction above which
+        checkpoint_state fetches whole leaves and slices on host
+        instead of a device-side row gather (a near-full gather costs
+        more than the contiguous fetch)."""
         with self.lock:
             self._delta_threshold = float(delta_threshold)
-            self._dirty = [
-                np.zeros(getattr(self, attr).num_slots, bool)
-                for _kind, attr, _ki in self._bank_table()]
+            if self._dirty is None:
+                self._dirty = [
+                    np.zeros(getattr(self, attr).num_slots, bool)
+                    for _kind, attr, _ki in self._bank_table()]
 
     def _mark_dirty(self, kind: int, slots):
-        """Record device-landing touches. Call sites guard on
-        self._dirty so the untracked default costs one attribute
-        load."""
-        d = self._dirty[kind]
+        """Record device-landing touches on the LIVE bitmap. Call
+        sites guard on self._dirty so the untracked case costs one
+        attribute load."""
+        self._mark_dirty_into(self._dirty, kind, slots)
+
+    @staticmethod
+    def _mark_dirty_into(dirty, kind: int, slots):
+        """Record device-landing touches on an explicit bitmap set —
+        the live one, or a retired interval's (the double-buffered
+        flush lands retired stages/imports AFTER the swap; their
+        touches belong to the retiring flush's dirty set, never the
+        new banks' checkpoint bitmap)."""
+        d = dirty[kind]
         s = np.asarray(slots)
         if s.size:
             d[s[(s >= 0) & (s < d.size)]] = True

@@ -84,11 +84,32 @@ _INF = jnp.inf
 # every compress. Read at TRACE time (the environment is consulted when
 # each program first compiles, not at import), so setting it any time
 # before the first compile works; already-compiled programs keep the arm
-# they were traced with. Kept until one TPU-live capture confirms the
-# merge-path win on hardware; capture_tpu_window.sh stages the A/B.
+# they were traced with. DEPRECATED (ISSUE 11): the merge path has been
+# the serving default since ISSUE 3 with a pinned 1.97x win and bitwise
+# A/B equivalence; the legacy arm is slated for removal once a TPU-live
+# capture (capture_tpu_window.sh) confirms the win on hardware — setting
+# the flag now warns loudly so deployments migrate off it first.
+_warned_full_sort = False
+
+
 def _full_sort_default() -> bool:
-    return os.environ.get("VENEUR_TPU_TDIGEST_FULL_SORT", "0") \
+    on = os.environ.get("VENEUR_TPU_TDIGEST_FULL_SORT", "0") \
         not in ("", "0")
+    global _warned_full_sort
+    if on and not _warned_full_sort:
+        _warned_full_sort = True
+        import logging
+        import warnings
+        msg = ("VENEUR_TPU_TDIGEST_FULL_SORT=1 forces the DEPRECATED "
+               "legacy full-row comparator sort in every t-digest "
+               "compress (~2x the merge-path cost, bitwise-identical "
+               "output). The flag and the legacy arm will be removed "
+               "after a TPU-live capture confirms the merge-path win "
+               "on hardware (ROADMAP flush item); unset it unless "
+               "running the bench A/B.")
+        warnings.warn(msg, DeprecationWarning, stacklevel=2)
+        logging.getLogger(__name__).warning(msg)
+    return on
 
 
 class TDigestBank(NamedTuple):

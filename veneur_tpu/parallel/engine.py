@@ -43,6 +43,15 @@ logger = logging.getLogger(__name__)
 
 
 class MeshAggregationEngine(AggregationEngine):
+    # ISSUE 11 paths stay off here: the mesh engine owns SHARDED banks
+    # (no per-slot dirty bitmaps — it is likewise excluded from delta
+    # checkpoints) and its landing paths write self.me.banks in place,
+    # so the retired-snapshot landing of the double buffer does not
+    # apply. Flush keeps the legacy drain-under-lock ordering and the
+    # full collective merge.
+    _incremental_capable = False
+    _double_buffer_capable = False
+
     def __init__(self, config: EngineConfig, n_devices: int | None = None,
                  mesh=None, n_dp: int = 1):
         if config.forward_enabled:
@@ -269,12 +278,14 @@ class MeshAggregationEngine(AggregationEngine):
         self.me.banks = self.me._fresh_fn()
         return snap
 
-    def _flush_device(self, snap, phases=None) -> dict:
+    def _flush_device(self, snap, phases=None, dirty=None) -> dict:
         """Collective merge over the mesh, mapped onto the host-dict
         contract the shared assembly consumes. `phases` (the flight
-        recorder's stamp list) is accepted for signature parity with
-        the single-device engine; the mesh program is one collective
-        dispatch+fetch, recorded by the caller as the merge phase."""
+        recorder's stamp list) and `dirty` (always None here — the
+        mesh engine carries no per-slot bitmaps) are accepted for
+        signature parity with the single-device engine; the mesh
+        program is one collective dispatch+fetch, recorded by the
+        caller as the merge phase."""
         dev = self._fetch_flush(self.me.flush_device(snap))
         agg = dev["agg"]
         host = {

@@ -86,6 +86,10 @@ class Server:
             idle_ttl_intervals=cfg.tpu_slot_idle_ttl_intervals,
             flush_fetch=cfg.tpu_flush_fetch,
             flush_fetch_f16=cfg.tpu_flush_fetch_f16,
+            flush_incremental=cfg.tpu_flush_incremental,
+            flush_incremental_threshold=
+            cfg.tpu_flush_incremental_threshold,
+            flush_double_buffer=cfg.tpu_flush_double_buffer,
             forward_enabled=bool(cfg.forward_address
                                  or cfg.consul_forward_service_name),
             # a server with a gRPC import listener is (also) a global tier

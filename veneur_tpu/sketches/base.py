@@ -44,6 +44,20 @@ HISTOGRAM ENGINES — own a bank NamedTuple with:
   Attributes: id, wire_version, import_strategy ("cluster"|"direct"),
   bank_leaves (durability leaf order), error_contract (doc string).
 
+INCREMENTAL-FLUSH CONTRACT (ISSUE 11 — holds for every engine, pinned
+per backend by tests/test_incremental_flush.py): the flush body may be
+evaluated over a row-gathered [D, ·] SLICE of the bank (the dirty
+work set) instead of the full [K, ·] bank, so every jit-composable op
+must be (a) shape-generic in the slot axis and (b) strictly
+row-independent — no op may couple one slot's output to another
+slot's state. Additionally a FRESH-INIT row must be a fixed point of
+compress and must materialize to a constant baseline row (quantiles/
+aggregates/estimate of an empty row depend on nothing but the engine
+params): the incremental flush scatters dirty-row outputs over that
+cached baseline, and cold piles keep their fresh-init state verbatim
+— bit-identity to the full program is the acceptance bar, not an
+approximation.
+
 SET ENGINES — own a bank NamedTuple with `registers: u8[K, m]` plus
   `num_slots`/`num_registers` properties. Methods:
     init(num_slots) -> bank

@@ -7,6 +7,11 @@ behavior-identical to the pre-registry tree.
 Error contract: LogLog-Beta estimation, relative standard error
 ~1.04/sqrt(m) (~0.81% at the default precision 14). State: m = 2^p
 u8 registers per slot (16 KiB at p=14).
+
+Incremental-flush contract (sketches/base.py): the register
+scatter-max and the LogLog-Beta estimate are per-row and
+shape-generic in K, and an all-zero row estimates to the constant
+baseline 0.0 — the [D, m] dirty-slice evaluation is exact.
 """
 
 from __future__ import annotations

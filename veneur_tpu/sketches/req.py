@@ -47,6 +47,12 @@ Zero canonicalization: -0.0 inputs are stored as +0.0 (matching the
 comparator canonicalization the t-digest sort applies), so the
 canonical item order — and therefore merge bit-identity — never
 depends on zero signs.
+
+Incremental-flush contract (sketches/base.py): every op here is
+row-independent and shape-generic in K — the compaction cascade,
+quantile sort, and scalar folds act per row — and a fresh-init row
+(all-zero items, n=0) is a compress fixed point (nl=0 never crosses
+the lazy trigger), so the [D, ·] dirty-slice evaluation is exact.
 """
 
 from __future__ import annotations

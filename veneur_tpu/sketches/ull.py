@@ -34,6 +34,13 @@ z = 2^-q), the event at q, and Bernoulli evidence at q-1 / q-2 from
 the indicator bits; the derivative in lam is monotone, so bisection is
 exact to float precision. Measured relative stderr at m = 8192 is
 ~0.85% (tests/test_sketches.py pins a 4-sigma bound).
+
+Incremental-flush contract (sketches/base.py): _value_counts vmaps
+per row and ml_estimate solves per slot — both row-independent and
+shape-generic in K — and an all-zero register row yields the constant
+baseline (counts[0] = m, estimate 0), so the [D, m] dirty-slice
+evaluation is exact; only active rows reach the host ML solve either
+way.
 """
 
 from __future__ import annotations
