@@ -2227,6 +2227,148 @@ def config18_incremental_flush():
           max_legacy / max(max_dbuf, 1e-6), "ratio", None)
 
 
+def config19_wire_compression():
+    """Bytes-on-the-wire A/B for the ISSUE 13 forward-path levers:
+    full-lossless vs delta vs delta+quantized-centroid (q16), at the
+    c12 1.6k-sketch shape and a 100k-sketch veneur-shaped mix at 10%
+    touched steady state, plus the serialization CPU cost of each arm
+    (fewer rows encoded also cuts the ~80ms/tick interval-serialization
+    cost the c12 journal bench measured).
+
+    Export semantics mirror models/pipeline.py's build exactly:
+      full   = the COMPLETE interned counter/set table (idle zeros /
+               empty register banks included — the resync payload and
+               what a correctness-conservative fleet ships every
+               interval) + touched histograms/gauges;
+      delta  = dirty-bitmap-touched keys only (steady-state interval);
+      q16    = the same delta under the packed centroid row.
+    Acceptance gates (ISSUE 13): at 100k/10%, delta >= 3x smaller than
+    full-lossless and delta+q16 >= 4x."""
+    from veneur_tpu.cluster import wire
+    from veneur_tpu.cluster.protos import forward_pb2
+    from veneur_tpu.ingest.parser import MetricKey
+    from veneur_tpu.models.pipeline import ForwardExport
+
+    rng = np.random.default_rng(19)
+
+    def mk_exports(n_histo, n_counter, n_gauge, n_set, set_regs,
+                   centroids, touched_frac):
+        """(full, delta) ForwardExport pair for one fleet shape."""
+        full, delta = ForwardExport(), ForwardExport(kind="delta")
+        t_h = max(1, int(n_histo * touched_frac))
+        t_c = max(1, int(n_counter * touched_frac))
+        t_g = max(1, int(n_gauge * touched_frac))
+        t_s = max(1, int(n_set * touched_frac))
+        for k in range(t_h):          # histograms: touched-only BOTH
+            means = np.sort(
+                rng.normal(100, 25, centroids).astype(np.float32))
+            weights = rng.uniform(0.5, 4.0, centroids).astype(np.float32)
+            row = (MetricKey(f"b.h{k}", "timer", "env:prod"), means,
+                   weights, float(means.min()), float(means.max()),
+                   float((means * weights).sum()), float(weights.sum()),
+                   1.0)
+            full.histograms.append(row)
+            delta.histograms.append(row)
+        for k in range(n_counter):    # counters: full ships idle zeros
+            key = MetricKey(f"b.c{k}", "counter", "")
+            v = float(rng.uniform(1, 1e6)) if k < t_c else 0.0
+            full.counters.append((key, v))
+            if k < t_c:
+                delta.counters.append((key, v))
+        for k in range(t_g):          # gauges: touched-only BOTH
+            row = (MetricKey(f"b.g{k}", "gauge", ""),
+                   float(rng.normal()))
+            full.gauges.append(row)
+            delta.gauges.append(row)
+        for k in range(n_set):        # sets: full ships empty banks
+            key = MetricKey(f"b.s{k}", "set", "")
+            regs = (rng.integers(0, 48, set_regs).astype(np.uint8)
+                    if k < t_s else np.zeros(set_regs, np.uint8))
+            full.sets.append((key, regs))
+            if k < t_s:
+                delta.sets.append((key, regs))
+        return full, delta
+
+    def pb_bytes(exp, codec):
+        return forward_pb2.MetricList(metrics=wire.export_to_metrics(
+            exp, codec=codec)).ByteSize()
+
+    def serialize_ms(exp, codec, reps):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            forward_pb2.MetricList(metrics=wire.export_to_metrics(
+                exp, codec=codec)).SerializeToString()
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times) * 1e3)
+
+    shapes = {
+        # the c12 1.6k-sketch shape (256h x 64c, 64 sets p12, 1024
+        # counters, 256 gauges) at 10% touched
+        "1k6": (256, 1024, 256, 64, 1 << 12, 64, 0.10, 9),
+        # 100k-sketch veneur-shaped mix: 60k histos (32 centroids
+        # when touched), 20k counters, 16k gauges, 4k sets (p12)
+        "100k": (60_000, 20_000, 16_000, 4_000, 1 << 12, 32, 0.10, 3),
+    }
+    for label, (nh, nc, ng, ns, regs, cents, frac, reps) in \
+            shapes.items():
+        full, delta = mk_exports(nh, nc, ng, ns, regs, cents, frac)
+        b_full = pb_bytes(full, "lossless")
+        b_delta = pb_bytes(delta, "lossless")
+        b_q16 = pb_bytes(delta, "q16")
+        _emit(f"c19_bytes_full_lossless_{label}", b_full, "bytes", None)
+        _emit(f"c19_bytes_delta_lossless_{label}", b_delta, "bytes",
+              None)
+        _emit(f"c19_bytes_delta_q16_{label}", b_q16, "bytes", None)
+        # acceptance gates at the 100k/10% shape: delta >= 3x,
+        # delta+quantized >= 4x vs full-lossless
+        _emit(f"c19_bytes_reduction_delta_x_{label}",
+              b_full / b_delta, "ratio",
+              3.0 if label == "100k" else None)
+        _emit(f"c19_bytes_reduction_delta_q16_x_{label}",
+              b_full / b_q16, "ratio",
+              4.0 if label == "100k" else None)
+        # the quantization lever in isolation: same (touched) histo
+        # rows, lossless vs packed centroid encoding
+        h_only = ForwardExport(histograms=full.histograms)
+        _emit(f"c19_centroid_bytes_reduction_q16_x_{label}",
+              pb_bytes(h_only, "lossless") / pb_bytes(h_only, "q16"),
+              "ratio", None)
+        # serialization CPU: rows not encoded are CPU not spent
+        ms_full = serialize_ms(full, "lossless", reps)
+        ms_delta = serialize_ms(delta, "lossless", reps)
+        ms_q16 = serialize_ms(delta, "q16", reps)
+        _emit(f"c19_serialize_cpu_ms_full_{label}", ms_full, "ms", None)
+        _emit(f"c19_serialize_cpu_ms_delta_{label}", ms_delta, "ms",
+              None)
+        _emit(f"c19_serialize_cpu_ms_delta_q16_{label}", ms_q16, "ms",
+              None)
+        _emit(f"c19_serialize_cpu_reduction_delta_x_{label}",
+              ms_full / max(ms_delta, 1e-9), "ratio", None)
+        # the jsonmetric-v1 contract tells the same story (hex-coded
+        # registers make idle sets even costlier there) — one shape is
+        # enough for the cross-contract sanity row
+        if label == "1k6":
+            from veneur_tpu.cluster.forward import HttpJsonForwarder
+            from veneur_tpu.resilience import Egress
+
+            def json_bytes(exp, codec):
+                fwd = HttpJsonForwarder(
+                    "http://x", egress=Egress(
+                        "x", transport=lambda *a, **k: None),
+                    centroid_codec=codec)
+                return len(json.dumps(
+                    fwd._body_entries(exp)).encode())
+            jb_full = json_bytes(full, "lossless")
+            jb_q16 = json_bytes(delta, "q16")
+            _emit("c19_json_bytes_full_lossless_1k6", jb_full, "bytes",
+                  None)
+            _emit("c19_json_bytes_delta_q16_1k6", jb_q16, "bytes",
+                  None)
+            _emit("c19_json_bytes_reduction_delta_q16_x_1k6",
+                  jb_full / jb_q16, "ratio", None)
+
+
 CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            3: config3_sets_1m_uniques, 4: config4_forward_merge_32_shards,
            5: config5_multichip_100k, 6: config6_e2e_udp_ingest,
@@ -2239,7 +2381,8 @@ CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            15: config15_fleet_tracing,
            16: config16_engine_checkpoint,
            17: config17_sketch_engines,
-           18: config18_incremental_flush}
+           18: config18_incremental_flush,
+           19: config19_wire_compression}
 
 
 def _run_isolated(configs: list[int], json_out: str) -> int:

@@ -154,9 +154,24 @@ class TestRandomizedRoundtrip:
     def test_begin_record_roundtrip(self):
         exp = mk_export(3)
         payload = drec.encode_begin(42, 2, 5, 1, exp)
-        seq, off, cnt, age, back = drec.decode_begin(payload)
-        assert (seq, off, cnt, age) == (42, 2, 5, 1)
+        seq, off, cnt, age, back, kind = drec.decode_begin(payload)
+        assert (seq, off, cnt, age, kind) == (42, 2, 5, 1, "full")
         assert_export_equal(exp, back)
+
+    def test_begin_record_pins_delta_kind(self):
+        """A parked DELTA interval recovers as a delta (the kind byte
+        trails the export payload); a pre-ISSUE-13 record — no
+        trailing byte — reads as full, which every pre-delta interval
+        was."""
+        exp = mk_export(4)
+        payload = drec.encode_begin(7, 0, 0, 0, exp, "delta")
+        *_head, back, kind = drec.decode_begin(payload)
+        assert kind == "delta"
+        assert_export_equal(exp, back)
+        legacy = drec.encode_begin(7, 0, 0, 0, exp)[:-1]  # strip byte
+        *_head, back2, kind2 = drec.decode_begin(legacy)
+        assert kind2 == "full"
+        assert_export_equal(exp, back2)
 
     def test_journal_append_reload_roundtrip(self, tmp_path):
         rng = random.Random(11)

@@ -269,7 +269,7 @@ class TestWireAndStamps:
     def test_stamp_parse_and_compat(self):
         default = sketches.DEFAULT_STAMP
         assert sketches.parse_stamp(default) == {
-            "h": ("tdigest", 1), "s": ("hll", 1)}
+            "h": ("tdigest", 1, "lossless"), "s": ("hll", 1, "lossless")}
         # absent stamp == legacy default pair
         assert sketches.stamp_compatible(default, None)
         assert sketches.stamp_compatible(default, default)
@@ -279,6 +279,22 @@ class TestWireAndStamps:
         assert not sketches.stamp_compatible(other, None)
         # malformed stamps are the mismatch case, never the legacy case
         assert not sketches.stamp_compatible(default, "junk")
+
+    def test_stamp_centroid_codec_marker(self):
+        """The q16 codec is part of the wire format: folded into the
+        histogram component's version ("1q"), so a quantized fleet and
+        a lossless fleet refuse each other loudly — and legacy (no
+        stamp) peers refuse a q16 fleet too."""
+        default = sketches.DEFAULT_STAMP
+        q = sketches.stamp_with_codec(default, "q16")
+        assert q == "h=tdigest/1q,s=hll/1"
+        assert sketches.stamp_with_codec(default, "lossless") == default
+        assert sketches.parse_stamp(q) == {
+            "h": ("tdigest", 1, "q16"), "s": ("hll", 1, "lossless")}
+        assert sketches.stamp_compatible(q, q)
+        assert not sketches.stamp_compatible(q, default)
+        assert not sketches.stamp_compatible(default, q)
+        assert not sketches.stamp_compatible(q, None)
 
     def test_engine_stamp_of_config(self):
         e = AggregationEngine(EngineConfig(

@@ -362,7 +362,7 @@ def test_multi_engine_flush_overlaps():
     serialized = []
 
     class FakeEngine:
-        def flush(self, timestamp=None):
+        def flush(self, timestamp=None, forward_kind="full"):
             try:
                 all_in_flush.wait()
             except threading.BrokenBarrierError:

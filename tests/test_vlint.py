@@ -166,6 +166,27 @@ def test_tr01_out_of_scope_modules_unchecked():
     assert [v for v in run_paths([path]) if v.rule == "TR01"] == []
 
 
+def test_wc01_q16_spellings_outside_wire():
+    # the hand-rolled JSON key (15), the pb-field read (19) and write
+    # (23); the docstring mention and the suppressed presence probe
+    # stay silent
+    assert lint("wc01_bad.py") == [("WC01", 15), ("WC01", 19),
+                                   ("WC01", 23)]
+
+
+def test_wc01_allows_wire_itself():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "cluster", "wire.py")
+    assert [v for v in run_paths([path]) if v.rule == "WC01"] == []
+
+
+def test_wc01_out_of_scope_modules_unchecked():
+    # tooling outside veneur_tpu/ may name the wire keys freely
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "tools", "vlint", "py_checks.py")
+    assert [v for v in run_paths([path]) if v.rule == "WC01"] == []
+
+
 def test_ov01_uncounted_drop_verdicts():
     # the uncounted branch drop (12), the count-in-another-branch drop
     # (21) and the bare-return drop (39); the counted verdicts, the

@@ -253,6 +253,171 @@ def test_jsonmetric_v1_envelope_headers_golden():
          "X-Veneur-Interval-Seq": "7"}) == ("s1", 7, 0, 1)
 
 
+# --- quantized-centroid wire row (q16, ISSUE 13) ---
+
+def _golden_q16_row():
+    # means [1.0, 3.0] weights [2.0, 1.0]: lo=1.0 hi=3.0, grid points
+    # 0 and 65535 (endpoints are exact), weights 1/8-fixed -> 16, 8
+    return (struct.pack("<Iff", 2, 1.0, 3.0)
+            + struct.pack("<HH", 0, 65535)
+            + bytes([16]) + bytes([8]))
+
+
+def test_q16_row_golden_bytes():
+    row = wire.encode_q16_centroids(np.array([1.0, 3.0]),
+                                    np.array([2.0, 1.0]))
+    assert row == _golden_q16_row()
+    means, weights = wire.decode_q16_centroids(row)
+    np.testing.assert_array_equal(means, np.float32([1.0, 3.0]))
+    np.testing.assert_array_equal(weights, np.float32([2.0, 1.0]))
+
+
+def test_q16_metric_golden_bytes():
+    """The pb carrier: TDigest.packed_centroids = 7 replaces the
+    repeated Centroid list when the sender's codec is q16, and
+    td_centroids decodes either representation."""
+    export = ForwardExport()
+    export.histograms.append(
+        (MetricKey("h", "histogram", "k:v"),
+         np.array([1.0, 3.0]), np.array([2.0, 1.0]),
+         1.0, 3.0, 5.0, 3.0, 7.0 / 6.0))
+    (m,) = wire.export_to_metrics(export, codec="q16")
+    tdigest = (_d(2, 1.0) + _d(3, 3.0) + _d(4, 5.0) + _d(5, 3.0)
+               + _d(6, 7.0 / 6.0)
+               + _ld(7, _golden_q16_row()))   # packed_centroids = 7
+    golden = (
+        _s(1, "h") + _s(2, "k:v")
+        + _vi(3, 2)
+        + _ld(6, _ld(1, tdigest))
+        + _vi(8, 2)
+    )
+    assert m.SerializeToString() == golden
+    back = metric_pb2.Metric.FromString(golden)
+    means, weights = wire.td_centroids(back.histogram.t_digest)
+    np.testing.assert_array_equal(means, np.float32([1.0, 3.0]))
+    np.testing.assert_array_equal(weights, np.float32([2.0, 1.0]))
+    # a lossless metric still decodes through the same entry point
+    (m_ll,) = wire.export_to_metrics(export)
+    assert len(m_ll.histogram.t_digest.packed_centroids) == 0
+    means2, _w2 = wire.td_centroids(m_ll.histogram.t_digest)
+    np.testing.assert_array_equal(means2, np.float32([1.0, 3.0]))
+
+
+def test_q16_roundtrip_within_quantization_bound():
+    import random
+    rng = random.Random(17)
+    for _trial in range(100):
+        n = rng.randrange(1, 80)
+        means = np.float32([rng.uniform(-1e6, 1e6) for _ in range(n)])
+        weights = np.float32(
+            [rng.choice([1.0, 0.5, 3.25, 2.0, 1e5]) for _ in range(n)])
+        m2, w2 = wire.decode_q16_centroids(
+            wire.encode_q16_centroids(means, weights))
+        span = float(means.max() - means.min())
+        # mean error <= half a grid step (+ f32 rounding headroom)
+        assert np.abs(m2 - means).max() <= span / 65535 / 2 + abs(
+            span) * 1e-6 + 1e-3
+        # weight error <= half a 1/8 step
+        assert np.abs(w2 - weights).max() <= 1 / 16 + 1e-6
+        # endpoints land exactly on the grid
+        assert np.float32(m2.min()) == np.float32(means.min())
+        assert np.float32(m2.max()) == np.float32(means.max())
+
+
+def test_q16_edges_nan_negzero_empty():
+    # empty list -> 12-byte header, decodes to empty arrays
+    row = wire.encode_q16_centroids([], [])
+    assert row == struct.pack("<Iff", 0, 0.0, 0.0)
+    m, w = wire.decode_q16_centroids(row)
+    assert m.size == 0 and w.size == 0
+    # -0.0 canonicalizes to +0.0 (the affine grid has one zero)
+    m, w = wire.decode_q16_centroids(
+        wire.encode_q16_centroids([-0.0, -0.0], [1.0, 1.0]))
+    assert not np.signbit(m).any() and (m == 0.0).all()
+    # NaN/inf means REFUSE (caller falls back to the lossless row) —
+    # and export_to_metrics actually does fall back per metric
+    import pytest
+    with pytest.raises(ValueError):
+        wire.encode_q16_centroids([np.nan], [1.0])
+    with pytest.raises(ValueError):
+        wire.encode_q16_centroids([np.inf, 1.0], [1.0, 1.0])
+    # a non-finite (or varint-overflowing) WEIGHT refuses too — the
+    # fixed-point cast would silently delete the centroid otherwise
+    with pytest.raises(ValueError):
+        wire.encode_q16_centroids([1.0, 2.0], [np.inf, 2.0])
+    with pytest.raises(ValueError):
+        wire.encode_q16_centroids([1.0], [1e19])
+    export = ForwardExport()
+    export.histograms.append(
+        (MetricKey("h", "histogram", ""),
+         np.array([np.inf, 1.0]), np.array([1.0, 2.0]),
+         1.0, 1.0, 1.0, 3.0, 0.0))
+    (m_pb,) = wire.export_to_metrics(export, codec="q16")
+    td = m_pb.histogram.t_digest
+    assert len(td.packed_centroids) == 0 and len(td.centroids) == 2
+    # zero-weight entries drop, like the lossless row
+    m, w = wire.decode_q16_centroids(
+        wire.encode_q16_centroids([5.0, 6.0], [0.0, 2.0]))
+    np.testing.assert_array_equal(m, np.float32([6.0]))
+    # truncated rows refuse loudly
+    with pytest.raises(ValueError):
+        wire.decode_q16_centroids(_golden_q16_row()[:-3])
+
+
+def test_q16_json_carrier_roundtrip():
+    """The jsonmetric-v1 carrier: "centroids_q16" = base64(row); both
+    spellings decode through histogram_centroids_from_json."""
+    import base64
+    frag = wire.histogram_wire_fragment(
+        np.array([1.0, 3.0]), np.array([2.0, 1.0]), codec="q16")
+    assert frag == {"centroids_q16": base64.b64encode(
+        _golden_q16_row()).decode("ascii")}
+    m, w = wire.histogram_centroids_from_json(frag)
+    np.testing.assert_array_equal(m, np.float32([1.0, 3.0]))
+    lossless = wire.histogram_wire_fragment(
+        np.array([1.0, 3.0]), np.array([2.0, 1.0]))
+    assert lossless == {"centroids": [[1.0, 2.0], [3.0, 1.0]]}
+    m, w = wire.histogram_centroids_from_json(lossless)
+    np.testing.assert_array_equal(w, np.float32([2.0, 1.0]))
+
+
+# --- forward kind (delta marker): both arms ---
+
+def test_envelope_forward_kind_golden_bytes():
+    """Envelope.forward_kind = 8: emitted only for deltas — a full
+    envelope serializes byte-identically to the pre-delta format."""
+    env = wire.envelope_pb("s1", 7, 1, 3, kind="delta")
+    golden = _golden_envelope_bytes() + _vi(8, 1)
+    assert env.SerializeToString() == golden
+    back = forward_pb2.Envelope.FromString(golden)
+    assert back.forward_kind == 1
+    ml = forward_pb2.MetricList()
+    ml.envelope.CopyFrom(back)
+    assert wire.forward_kind_from_metric_list(ml) == "delta"
+    # full == legacy bytes
+    assert wire.envelope_pb("s1", 7, 1, 3).SerializeToString() == \
+        _golden_envelope_bytes()
+    assert wire.envelope_pb(
+        "s1", 7, 1, 3, kind="full").SerializeToString() == \
+        _golden_envelope_bytes()
+
+
+def test_jsonmetric_v1_forward_kind_headers_golden():
+    headers = wire.envelope_headers("s1", 7, 1, 3, kind="delta")
+    assert headers == {"X-Veneur-Sender-Id": "s1",
+                       "X-Veneur-Interval-Seq": "7",
+                       "X-Veneur-Chunk": "1/3",
+                       "X-Veneur-Forward-Kind": "delta"}
+    assert wire.forward_kind_from_headers(headers) == "delta"
+    # full emits NO kind header (legacy header sets byte-identical)
+    full = wire.envelope_headers("s1", 7, 1, 3)
+    assert wire.FORWARD_KIND_HEADER not in full
+    assert wire.forward_kind_from_headers(full) == "full"
+    # unknown kind values degrade to full (tolerant decode)
+    assert wire.forward_kind_from_headers(
+        {"X-Veneur-Forward-Kind": "banana"}) == "full"
+
+
 # --- SSF: span protobuf + stream frame ---
 
 def _golden_span():

@@ -134,4 +134,16 @@ DEFAULT_CONFIG = {
     "tr01_allow": (
         "veneur_tpu/cluster/wire.py",
     ),
+    # WC01: quantized-centroid codec single-homing (path substring
+    # match; /wc01_ scopes the check's own fixture in) — the q16 wire
+    # row's spellings ("centroids_q16" JSON key, `packed_centroids` pb
+    # field) and therefore its quantization math live ONLY in
+    # cluster/wire.py, like the envelope/trace codecs (TR01).
+    "wc01_scope": (
+        "veneur_tpu/",
+        "/wc01_",
+    ),
+    "wc01_allow": (
+        "veneur_tpu/cluster/wire.py",
+    ),
 }

@@ -935,10 +935,11 @@ def check_tl01(mod: PyModule, config: dict) -> list[Violation]:
 # ------------------------------------------------------------------- TR01
 
 # wire literals of the forward trace context + the envelope's gRPC
-# metadata carrier — matched case-insensitively, by prefix, so a
-# re-spelled header ("x-veneur-trace-parent") is still caught
+# metadata carrier + the delta/full forward-kind marker — matched
+# case-insensitively, by prefix, so a re-spelled header
+# ("x-veneur-trace-parent") is still caught
 _TR01_PREFIXES = ("x-veneur-trace", "x-veneur-interval-close",
-                  "veneur-envelope-bin")
+                  "x-veneur-forward-kind", "veneur-envelope-bin")
 
 
 def check_tr01(mod: PyModule, config: dict) -> list[Violation]:
@@ -970,6 +971,56 @@ def check_tr01(mod: PyModule, config: dict) -> list[Violation]:
                 "cluster/wire.py — the envelope/trace header and "
                 "metadata encodings are single-homed there (use the "
                 "wire.* codec helpers), or suppress with a reason"))
+    return out
+
+
+# ------------------------------------------------------------------- WC01
+
+# wire spellings of the quantized-centroid row: the jsonmetric-v1 key
+# and the metricpb TDigest bytes field. Touching either outside
+# cluster/wire.py means re-implementing the quantization /
+# dequantization math (or half of it) somewhere the golden-bytes tests
+# don't look.
+_WC01_LITERALS = ("centroids_q16", "packed_centroids")
+
+
+def check_wc01(mod: PyModule, config: dict) -> list[Violation]:
+    """Centroid quantization single-homing (the TR01 literal-scan
+    precedent, applied to the q16 codec): the quantized-centroid wire
+    row's spellings — the "centroids_q16" JSON key and the
+    `packed_centroids` pb field — may appear ONLY in cluster/wire.py,
+    as string literals OR attribute access (reading `td.
+    packed_centroids` elsewhere IS decoding outside the codec). Two
+    homes for an affine-quantization grid is how a sender and receiver
+    end up on different grids while every roundtrip test passes:
+    encode and dequantize must share one scale expression. Docstrings
+    are exempt (documentation names wire keys)."""
+    if not any(m in mod.path for m in config["wc01_scope"]):
+        return []
+    if any(mod.path.endswith(a) for a in config["wc01_allow"]):
+        return []
+    docstrings = _docstring_ids(mod.tree)
+    out = []
+    for node in ast.walk(mod.tree):
+        name = None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if id(node) in docstrings:
+                continue
+            if node.value.lower().startswith(_WC01_LITERALS):
+                name = node.value
+        elif isinstance(node, ast.Attribute) and \
+                node.attr in _WC01_LITERALS:
+            name = node.attr
+        if name is not None:
+            out.append(Violation(
+                mod.path, node.lineno, "WC01",
+                f"quantized-centroid wire spelling {name!r} outside "
+                "cluster/wire.py — the q16 encode/decode math and its "
+                "carriers are single-homed there (use wire."
+                "encode_q16_centroids / td_centroids / "
+                "histogram_wire_fragment / "
+                "histogram_centroids_from_json), or suppress with a "
+                "reason"))
     return out
 
 
@@ -1249,6 +1300,7 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_dr02(mod, config))
     out.extend(check_tl01(mod, config))
     out.extend(check_tr01(mod, config))
+    out.extend(check_wc01(mod, config))
     out.extend(check_ov01(mod, config))
     out.extend(check_sk01(mod, config))
     out.extend(check_ds01(mod, config))

@@ -18,7 +18,8 @@ import logging
 import urllib.request
 
 from ..models.pipeline import ForwardExport
-from ..resilience import (Egress, EgressPolicy, ForwardEnvelope,
+from ..resilience import (DeltaGapRefusedError, Egress, EgressPolicy,
+                          ForwardEnvelope, HTTPStatusError,
                           PartialDeliveryError, accepts_envelope,
                           grpc_channel)
 from . import wire
@@ -29,6 +30,48 @@ log = logging.getLogger("veneur_tpu.cluster.forward")
 SEND_METRICS = "/forwardrpc.Forward/SendMetrics"
 SEND_METRICS_V2 = "/forwardrpc.Forward/SendMetricsV2"
 
+# what a receiver puts on the wire when it refuses a delta over a seq
+# gap (importsrv aborts FAILED_PRECONDITION with this detail prefix;
+# the HTTP /import path answers 409) — the leaf forwarders translate
+# either into DeltaGapRefusedError so the replay layer falls back to a
+# full resync instead of parking an unapplyable delta. The spelling is
+# single-homed in wire.py with the other wire literals.
+DELTA_GAP_DETAIL = wire.DELTA_GAP_DETAIL
+
+
+def _is_delta_gap(exc: BaseException) -> bool:
+    """Did this egress failure carry the receiver's delta-over-gap
+    refusal? HTTP: status 409 (the import path's only 409). gRPC:
+    FAILED_PRECONDITION whose details lead with DELTA_GAP_DETAIL
+    (FAILED_PRECONDITION alone is also the engine-stamp mismatch)."""
+    import urllib.error
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code == 409
+    if isinstance(exc, HTTPStatusError):
+        return exc.status == 409
+    if callable(getattr(exc, "code", None)):
+        try:
+            import grpc
+            details = exc.details() if callable(
+                getattr(exc, "details", None)) else ""
+            return (exc.code() == grpc.StatusCode.FAILED_PRECONDITION
+                    and DELTA_GAP_DETAIL in (details or ""))
+        except Exception:
+            return False
+    return False
+
+
+def _count_forward_bytes(egress: Egress, nbytes: int, kind: str):
+    """Per-destination bytes-on-the-wire accounting (ISSUE 13): one
+    total plus a per-kind split, counted on successful delivery only
+    (retries of a failed chunk are visible as egress attempts). Drains
+    as veneur.forward.bytes_total / bytes_full_total /
+    bytes_delta_total, tagged destination:<scope>."""
+    reg, dest = egress.registry, egress.destination
+    reg.incr(dest, "forward.bytes", nbytes)
+    reg.incr(dest, "forward.bytes_delta" if kind == "delta"
+             else "forward.bytes_full", nbytes)
+
 
 class GrpcForwarder:
     """Callable handed to Server.forwarder: ships a flush's exports
@@ -38,13 +81,20 @@ class GrpcForwarder:
                  max_per_batch: int = 10_000,
                  egress: Egress | None = None,
                  egress_policy: EgressPolicy | None = None,
-                 engine_stamp: str | None = None):
+                 engine_stamp: str | None = None,
+                 centroid_codec: str = "lossless"):
         self.address = address
         self.timeout_s = timeout_s
         self.max_per_batch = max_per_batch
         # sketch-engine/wire-format stamp declared on every chunk
-        # (ISSUE 10 mixed-fleet safety); None = legacy (unstamped)
+        # (ISSUE 10 mixed-fleet safety); None = legacy (unstamped).
+        # Callers fold the centroid codec into the stamp
+        # (sketches.stamp_with_codec) so a q16 fleet reads as a
+        # distinct wire format.
         self.engine_stamp = engine_stamp
+        # centroid wire row: "lossless" (repeated Centroid messages)
+        # or "q16" (the packed quantized row, ISSUE 13)
+        self.centroid_codec = centroid_codec
         self._egress = egress or Egress(f"grpc://{address}",
                                         policy=egress_policy)
         self._channel = grpc_channel(address)
@@ -64,10 +114,12 @@ class GrpcForwarder:
         during an ambiguous failure. All batches share ONE deadline
         budget — N batches cannot stall the flush tick for
         N x retry_deadline."""
-        metrics = wire.export_to_metrics(export)
+        metrics = wire.export_to_metrics(export,
+                                         codec=self.centroid_codec)
         deadline = self._egress.deadline()
         n_chunks = -(-len(metrics) // self.max_per_batch)
         total = 0
+        kind = envelope.kind if envelope is not None else "full"
         if envelope is not None:
             total = envelope.chunk_count or (envelope.chunk_offset
                                              + n_chunks)
@@ -87,17 +139,29 @@ class GrpcForwarder:
                     envelope.chunk_offset + j, total,
                     trace_id=envelope.trace_id,
                     span_id=envelope.span_id,
-                    close_ns=envelope.close_ns))
+                    close_ns=envelope.close_ns,
+                    kind=kind))
             try:
                 self._egress.call(self._send, batch,
                                   timeout_s=self.timeout_s,
                                   deadline=deadline)
             except Exception as e:
+                if kind == "delta" and _is_delta_gap(e):
+                    # receiver refused the whole seq before applying
+                    # anything; the replay layer falls back to full.
+                    # Gated on kind: a full send can never be gap-
+                    # refused (receivers only gap-check deltas), so a
+                    # 409/FAILED_PRECONDITION there is some foreign
+                    # intermediary's error and must stay on the
+                    # exactly-once park path, not the spill fallback.
+                    raise DeltaGapRefusedError(
+                        f"{self.address}: {e}") from e
                 if j == 0:
                     raise    # nothing delivered: spill the whole export
                 raise PartialDeliveryError(
                     _export_tail(export, i), e, delivered_chunks=j,
                     chunk_count=total or n_chunks) from e
+            _count_forward_bytes(self._egress, batch.ByteSize(), kind)
 
     def send_metrics(self, metrics: list, envelope=None,
                      sketch_engines=None, prefix_sketches=None):
@@ -124,6 +188,9 @@ class GrpcForwarder:
             self._egress.call(self._send, batch,
                               timeout_s=self.timeout_s,
                               deadline=deadline)
+            _count_forward_bytes(
+                self._egress, batch.ByteSize(),
+                "delta" if envelope.forward_kind == 1 else "full")
             return
         for j, i in enumerate(range(0, len(metrics),
                                     self.max_per_batch)):
@@ -136,6 +203,7 @@ class GrpcForwarder:
             self._egress.call(self._send, batch,
                               timeout_s=self.timeout_s,
                               deadline=deadline)
+            _count_forward_bytes(self._egress, batch.ByteSize(), "full")
 
     def close(self):
         self._channel.close()
@@ -181,31 +249,46 @@ class HttpJsonForwarder:
                  max_per_body: int = 25_000,
                  egress: Egress | None = None,
                  egress_policy: EgressPolicy | None = None,
-                 engine_stamp: str | None = None):
+                 engine_stamp: str | None = None,
+                 centroid_codec: str = "lossless"):
         self.url = base_url.rstrip("/") + "/import"
         self.timeout_s = timeout_s
         self.max_per_body = max_per_body
         self.engine_stamp = engine_stamp
+        self.centroid_codec = centroid_codec
         self._egress = egress or Egress(self.url, policy=egress_policy)
 
-    @staticmethod
-    def _body_entries(export: ForwardExport) -> list:
+    def _flush_headers(self) -> dict:
+        """The per-FLUSH static header set (format version + engine/
+        wire stamp): computed ONCE per __call__ and copied per chunk —
+        the send loop must never recompute the stamp per chunk
+        (pinned by a call-count test; the per-chunk work is only the
+        envelope fields, which genuinely vary per chunk)."""
+        headers = {"Content-Type": "application/json",
+                   "X-Veneur-Forward-Version": self.FORMAT}
+        if self.engine_stamp:
+            headers[wire.SKETCH_HEADER] = self.engine_stamp
+        return headers
+
+    def _body_entries(self, export: ForwardExport) -> list:
         """JSONMetric dicts in WIRE ORDER (histograms, sets, counters,
         gauges) — entry i corresponds 1:1 to metric i of
         wire.export_to_metrics, so `_export_tail` maps a chunk index
-        back to an export for both contracts identically."""
+        back to an export for both contracts identically. The centroid
+        carrier ("centroids" vs the q16 "centroids_q16" row) follows
+        self.centroid_codec; the spelling lives in wire.py (WC01)."""
         body = []
         for key, means, weights, vmin, vmax, vsum, cnt, recip in (
                 export.histograms):
+            h = wire.histogram_wire_fragment(means, weights,
+                                             codec=self.centroid_codec)
+            h.update({"min": float(vmin), "max": float(vmax),
+                      "sum": float(vsum), "count": float(cnt),
+                      "reciprocal_sum": float(recip)})
             body.append({
                 "name": key.name, "type": key.type,
                 "tags": wire._split_tags(key.joined_tags),
-                "histogram": {
-                    "centroids": [[float(m), float(w)]
-                                  for m, w in zip(means, weights)],
-                    "min": float(vmin), "max": float(vmax),
-                    "sum": float(vsum), "count": float(cnt),
-                    "reciprocal_sum": float(recip)}})
+                "histogram": h})
         for key, regs in export.sets:
             body.append({"name": key.name, "type": "set",
                          "tags": wire._split_tags(key.joined_tags),
@@ -232,15 +315,14 @@ class HttpJsonForwarder:
         deadline = self._egress.deadline()
         n_chunks = -(-len(body) // self.max_per_body)
         total = 0
+        kind = envelope.kind if envelope is not None else "full"
+        base_headers = self._flush_headers()
         if envelope is not None:
             total = envelope.chunk_count or (envelope.chunk_offset
                                              + n_chunks)
         for j in range(n_chunks):
             i = j * self.max_per_body
-            headers = {"Content-Type": "application/json",
-                       "X-Veneur-Forward-Version": self.FORMAT}
-            if self.engine_stamp:
-                headers[wire.SKETCH_HEADER] = self.engine_stamp
+            headers = dict(base_headers)
             if j == 0 and export.prefix_sketches:
                 # headers have practical size limits: cap the advisory
                 # rows (the pb contract carries the full set)
@@ -253,20 +335,27 @@ class HttpJsonForwarder:
                     envelope.chunk_offset + j, total,
                     trace_id=envelope.trace_id,
                     span_id=envelope.span_id,
-                    close_ns=envelope.close_ns))
+                    close_ns=envelope.close_ns,
+                    kind=kind))
+            data = json.dumps(body[i:i + self.max_per_body]).encode()
             req = urllib.request.Request(
-                self.url,
-                data=json.dumps(body[i:i + self.max_per_body]).encode(),
-                headers=headers, method="POST")
+                self.url, data=data, headers=headers, method="POST")
             try:
                 self._egress.post(req, timeout_s=self.timeout_s,
                                   deadline=deadline)
             except Exception as e:
+                # kind-gated like the gRPC arm: only a DELTA chunk can
+                # be gap-refused; a stray 409 on a full send stays on
+                # the exactly-once park path
+                if kind == "delta" and _is_delta_gap(e):
+                    raise DeltaGapRefusedError(
+                        f"{self.url}: {e}") from e
                 if j == 0:
                     raise
                 raise PartialDeliveryError(
                     _export_tail(export, i), e, delivered_chunks=j,
                     chunk_count=total or n_chunks) from e
+            _count_forward_bytes(self._egress, len(data), kind)
 
 
 class DiscoveringForwarder:
@@ -283,7 +372,8 @@ class DiscoveringForwarder:
                  forwarder_factory=None, timeout_s: float = 10.0,
                  max_per_body: int = 25_000,
                  egress_policy: EgressPolicy | None = None,
-                 engine_stamp: str | None = None):
+                 engine_stamp: str | None = None,
+                 centroid_codec: str = "lossless"):
         self.discoverer = discoverer
         self.service = service
         self.refresh_interval_s = refresh_interval_s
@@ -292,20 +382,32 @@ class DiscoveringForwarder:
                 forwarder_factory = lambda dest: GrpcForwarder(  # noqa: E731
                     dest, timeout_s=timeout_s,
                     egress_policy=egress_policy,
-                    engine_stamp=engine_stamp)
+                    engine_stamp=engine_stamp,
+                    centroid_codec=centroid_codec)
             else:
                 # same body-size knob the direct-address path honors
                 forwarder_factory = lambda dest: HttpJsonForwarder(  # noqa: E731
                     dest, timeout_s=timeout_s,
                     max_per_body=max_per_body,
                     egress_policy=egress_policy,
-                    engine_stamp=engine_stamp)
+                    engine_stamp=engine_stamp,
+                    centroid_codec=centroid_codec)
         self.factory = forwarder_factory
         self._dests: list[str] = []
         self._fwds: dict = {}
         self._next_refresh = 0.0
         self._rr = 0
         self.errors = 0
+
+    @property
+    def delta_capable(self) -> bool:
+        """Delta forwarding needs ONE stable destination: with several
+        discovered globals the seq-deterministic rotation means no
+        single receiver observes a contiguous seq chain, so every
+        delta would read as a gap. The ResilientForwarder consults
+        this before building a delta; a multi-destination fleet keeps
+        full sends (documented in README "Wire compression")."""
+        return len(self._dests) <= 1
 
     def _refresh(self):
         import time as _t

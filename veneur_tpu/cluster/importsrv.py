@@ -64,13 +64,18 @@ class ImportedBatch:
 
 
 class _SenderState:
-    __slots__ = ("watermark", "seqs", "last_seen")
+    __slots__ = ("watermark", "seqs", "last_seen", "max_seq")
 
     def __init__(self, now: float):
         self.watermark = 0          # every seq <= watermark is a dup
         # seq -> [set(chunk_idx), expected_chunk_count (0 = unknown)]
         self.seqs: OrderedDict = OrderedDict()
         self.last_seen = now
+        # highest seq EVER seen from this sender (admitted or deduped)
+        # — the delta gap check's baseline: a delta at seq <= max_seq+1
+        # sits on an unbroken chain (the sender emits seqs contiguously
+        # and replays in order, so seeing N implies N-1.. were offered)
+        self.max_seq = 0
 
 
 class DedupeLedger:
@@ -160,6 +165,7 @@ class DedupeLedger:
                 st.last_seen = now
             if seq <= st.watermark:
                 return self._drop()
+            st.max_seq = max(st.max_seq, seq)
             entry = st.seqs.get(seq)
             if entry is None:
                 entry = st.seqs[seq] = [set(), int(chunk_count or 0)]
@@ -188,6 +194,31 @@ class DedupeLedger:
             chunks.add(chunk_index)
             self._size += 1
             return True
+
+    def check_delta(self, sender_id: str, seq: int) -> bool:
+        """May a DELTA chunk at `seq` be applied for this sender? True
+        iff the sender's seq chain is unbroken below it: some seq has
+        been seen before AND `seq` is at most one past the highest
+        (equal-or-below = a replay/extra chunk, dedupe decides). False
+        — counted `veneur.forward.delta_gap_refused_total` — when the
+        sender is unknown (this receiver has no baseline: a restart
+        without durable watermarks, or a brand-new sender whose first
+        send should have been full) or `seq` skips ahead (an earlier
+        interval was demoted to the sender's re-envelope tier and will
+        never arrive under its own seq). The caller refuses the chunk
+        LOUDLY before any decode/apply work; the sender's fallback
+        spills the payload and forces a full resync, so refusal never
+        loses data. Consulted BEFORE admit() — a refusal must not mark
+        chunks as seen."""
+        with self._lock:
+            st = self._senders.get(sender_id)
+            if st is not None:
+                last = max(st.watermark, st.max_seq)
+                if last > 0 and seq <= last + 1:
+                    return True
+            self._registry.incr(self.destination,
+                                "forward.delta_gap_refused")
+            return False
 
     def max_admitted(self) -> dict:
         """Per-sender max COMPLETELY-admitted interval_seq (the
@@ -229,6 +260,7 @@ class DedupeLedger:
                         self._forget_sender(next(iter(self._senders)))
                     st = self._senders[sender_id] = _SenderState(now)
                 st.watermark = max(st.watermark, int(seq))
+                st.max_seq = max(st.max_seq, int(seq))
                 n += 1
         return n
 
@@ -373,6 +405,18 @@ class ForwardHandler(grpc.GenericRpcHandler):
             return True
         return self._ledger.admit(*env)
 
+    def _delta_gap(self, env, kind: str) -> bool:
+        """Gap verdict for one request, BEFORE any metric is routed: a
+        delta may only be applied over an unbroken per-sender seq
+        chain (check_delta counts refusals). Envelope-less or
+        ledger-less receivers cannot gap-check and apply the delta
+        as-is (merge semantics stay sound; documented degradation).
+        The caller aborts with the DELTA_GAP_DETAIL marker so the
+        sender's fallback (spill + full resync) recognizes it."""
+        if kind != "delta" or env is None or self._ledger is None:
+            return False
+        return not self._ledger.check_delta(env[0], env[1])
+
     def _apply(self, scope, env, metrics) -> None:
         """The shared admit-then-route tail, phase-attributed."""
         ph = scope.start("dedupe")
@@ -393,6 +437,13 @@ class ForwardHandler(grpc.GenericRpcHandler):
         if not self._check_stamp(remote, env):
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           "sketch engine/wire-format mismatch")
+        if self._delta_gap(env,
+                           wire.forward_kind_from_metric_list(request)):
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"{wire.DELTA_GAP_DETAIL}: no unbroken seq chain "
+                f"below delta seq {env[1]} for sender {env[0]!r}; "
+                "send a full resync")
         if self._merge_sketches is not None and request.prefix_sketches:
             self._merge_sketches(wire.prefix_sketches_from_pb(request))
         obs = self._observer
@@ -414,6 +465,14 @@ class ForwardHandler(grpc.GenericRpcHandler):
         if not self._check_stamp(remote, env):
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           "sketch engine/wire-format mismatch")
+        if self._delta_gap(env, wire.forward_kind_from_metadata(md)):
+            # before the stream is consumed: nothing is admitted, the
+            # sender's whole-interval fallback re-routes the payload
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"{wire.DELTA_GAP_DETAIL}: no unbroken seq chain "
+                f"below delta seq {env[1]} for sender {env[0]!r}; "
+                "send a full resync")
         obs = self._observer
         kw = {} if self._engine_stamp is None else {"stamp": remote}
         if env is None or self._ledger is None:

@@ -367,6 +367,11 @@ class HttpProxyFront:
                     wire.ENVELOPE_SENDER_HEADER,
                     wire.ENVELOPE_SEQ_HEADER,
                     wire.ENVELOPE_CHUNK_HEADER,
+                    # the delta/full marker rides verbatim: a proxy
+                    # that stripped it would make every delta read as
+                    # full downstream and silently disarm the
+                    # receiver's gap check
+                    wire.FORWARD_KIND_HEADER,
                     wire.TRACE_HEADER,
                     wire.TRACE_CLOSE_HEADER,
                     # engine stamp + advisory cardinality rows ride

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import base64
 import json
+import struct
 
 import numpy as np
 
@@ -129,24 +130,29 @@ TRACE_CLOSE_HEADER = "X-Veneur-Interval-Close-Ns"
 
 def envelope_pb(sender_id: str, interval_seq: int, chunk_index: int,
                 chunk_count: int, trace_id: int = 0, span_id: int = 0,
-                close_ns: int = 0):
+                close_ns: int = 0, kind: str = "full"):
     return forward_pb2.Envelope(
         sender_id=sender_id, interval_seq=int(interval_seq),
         chunk_index=int(chunk_index), chunk_count=int(chunk_count),
         trace_id=int(trace_id), span_id=int(span_id),
-        interval_close_ns=int(close_ns))
+        interval_close_ns=int(close_ns),
+        forward_kind=_KIND_TO_PB.get(kind, 0))
 
 
 def envelope_headers(sender_id: str, interval_seq: int, chunk_index: int,
                      chunk_count: int, trace_id: int = 0,
-                     span_id: int = 0, close_ns: int = 0) -> dict:
+                     span_id: int = 0, close_ns: int = 0,
+                     kind: str = "full") -> dict:
     """The jsonmetric-v1 header encoding of one chunk's envelope (plus
     its trace context, when the sender has one — zero trace_id emits
-    no trace headers, keeping legacy header sets byte-identical)."""
+    no trace headers, and a full-kind chunk emits no kind header,
+    keeping legacy header sets byte-identical)."""
     out = {ENVELOPE_SENDER_HEADER: sender_id,
            ENVELOPE_SEQ_HEADER: str(int(interval_seq)),
            ENVELOPE_CHUNK_HEADER:
                f"{int(chunk_index)}/{int(chunk_count)}"}
+    if kind == KIND_DELTA:
+        out[FORWARD_KIND_HEADER] = KIND_DELTA
     if trace_id:
         out[TRACE_HEADER] = f"{int(trace_id)}:{int(span_id)}"
         if close_ns:
@@ -244,6 +250,57 @@ def envelope_from_metadata(metadata) -> tuple | None:
                     e.chunk_count)
     return None
 
+# ---- forward kind: full | delta (ISSUE 13 delta forwarding) ----
+#
+# Every enveloped chunk declares whether its payload is a FULL export
+# (the sender's complete active sketch set — and the gap-baseline
+# reset) or a DELTA (only the sketches the dirty-slot bitmap saw
+# touched this interval). Carriers: Envelope.forward_kind (field 8;
+# 0 = full and every legacy chunk, 1 = delta) on the forwardrpc arm
+# and inside the serialized `veneur-envelope-bin` SendMetricsV2
+# metadata, and the header below on jsonmetric-v1 — emitted ONLY for
+# deltas, so full/legacy header sets stay byte-identical. Decode is
+# tolerant: an unknown kind reads as "full" (full skips the gap check
+# and merge-applies, which is always sound; a delta misread as full
+# can never corrupt state, only skip a belt-check). The field<->header
+# mapping lives ONLY here (vlint TR01, same single home as the
+# envelope/trace codecs).
+
+FORWARD_KIND_HEADER = "X-Veneur-Forward-Kind"
+KIND_FULL = "full"
+KIND_DELTA = "delta"
+_KIND_TO_PB = {KIND_FULL: 0, KIND_DELTA: 1}
+
+# the wire marker of a delta-over-gap refusal — the receiver puts it
+# in the FAILED_PRECONDITION details (gRPC) and the 409 body's
+# "error" field (HTTP); the sender-side leaf forwarders match on it
+# to translate the refusal into DeltaGapRefusedError. One spelling,
+# here, like every other wire literal in this module.
+DELTA_GAP_DETAIL = "delta-over-gap"
+
+
+def forward_kind_from_headers(headers) -> str:
+    v = _header_get(headers, FORWARD_KIND_HEADER)
+    return KIND_DELTA if v == KIND_DELTA else KIND_FULL
+
+
+def forward_kind_from_metric_list(ml) -> str:
+    if ml.HasField("envelope") and ml.envelope.forward_kind == 1:
+        return KIND_DELTA
+    return KIND_FULL
+
+
+def forward_kind_from_metadata(metadata) -> str:
+    for key, value in metadata or ():
+        if key == ENVELOPE_METADATA_KEY:
+            try:
+                e = forward_pb2.Envelope.FromString(value)
+            except Exception:
+                return KIND_FULL
+            return KIND_DELTA if e.forward_kind == 1 else KIND_FULL
+    return KIND_FULL
+
+
 _TYPE_TO_PB = {
     "counter": metric_pb2.Counter,
     "gauge": metric_pb2.Gauge,
@@ -280,8 +337,169 @@ def decode_set_payload(data: bytes) -> tuple:
     return sketches.decode_set_registers(data)
 
 
-def export_to_metrics(export: ForwardExport) -> list:
-    """ForwardExport -> [metricpb.Metric] (the flush-side serialization)."""
+# ---- quantized-centroid wire row (ISSUE 13, vlint WC01) ----
+#
+# The q16 codec: one histogram's centroid list packed as
+#
+#     u32 n | f32 lo | f32 hi | n x u16 q_mean | n x varint q_weight
+#
+# (little-endian). Means are affine-quantized onto a per-list 16-bit
+# grid between lo = min(means) and hi = max(means): the endpoints are
+# exact, interior points carry <= (hi-lo)/65535/2 absolute error — the
+# bounded mean-perturbation t-digest quantile bounds tolerate (arxiv
+# 1902.04023; the exact count/sum/min/max ride the untouched TDigest
+# scalar fields either way). Weights are 1/8-fixed-point varints,
+# floored at 1/8 so a live centroid can never quantize to dead:
+# q_w = max(1, round(w * 8)). -0.0 canonicalizes to +0.0 (the affine
+# grid has one zero); non-finite means REFUSE (ValueError) and the
+# caller falls back to the lossless row for that metric — quantization
+# is a bytes optimization, never a correctness gamble. The math lives
+# ONLY here (vlint WC01 flags the wire-key literals elsewhere), and
+# the JSON carrier key is "centroids_q16" (base64 of this row).
+
+Q16_JSON_KEY = "centroids_q16"
+_Q16_GRID = 65535
+_Q16_WSCALE = 8.0
+_Q16_HEAD = struct.Struct("<Iff")
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(data: bytes, off: int):
+    shift = result = 0
+    while True:
+        if off >= len(data):
+            raise ValueError("truncated q16 varint")
+        b = data[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, off
+        shift += 7
+        if shift > 63:
+            raise ValueError("oversized q16 varint")
+
+
+def encode_q16_centroids(means, weights) -> bytes:
+    """Pack (means, weights) into the q16 row. Zero/negative-weight
+    entries are dropped (mirroring the lossless row); non-finite means
+    raise ValueError (caller falls back to lossless for that metric)."""
+    means = np.asarray(means, np.float64)
+    weights = np.asarray(weights, np.float64)
+    live = weights > 0
+    means, weights = means[live], weights[live]
+    if means.size and not np.isfinite(means).all():
+        raise ValueError("non-finite centroid mean refuses q16")
+    if weights.size and (not np.isfinite(weights).all()
+                         or float(weights.max()) * _Q16_WSCALE >= 2**63):
+        # an inf/NaN (or varint-overflowing) weight would cast to 0 in
+        # the fixed-point step and silently DELETE a live centroid —
+        # refuse instead, like non-finite means (caller falls back to
+        # the lossless row for this metric)
+        raise ValueError("non-finite/oversized centroid weight "
+                         "refuses q16")
+    n = int(means.size)
+    if n == 0:
+        return _Q16_HEAD.pack(0, 0.0, 0.0)
+    # + 0.0 canonicalizes -0.0 endpoints (one zero on the grid)
+    lo = float(means.min()) + 0.0
+    hi = float(means.max()) + 0.0
+    span = hi - lo
+    if span > 0:
+        q = np.rint((means - lo) * (_Q16_GRID / span))
+        q = np.clip(q, 0, _Q16_GRID).astype(np.uint16)
+    else:
+        q = np.zeros(n, np.uint16)
+    qw = np.maximum(1, np.rint(weights * _Q16_WSCALE)).astype(np.uint64)
+    return (_Q16_HEAD.pack(n, lo, hi)
+            # vlint: disable=DR02 reason=the q16 centroid WIRE row
+            # (deliberately lossy quantized means, not a bank leaf);
+            # single-homed here per WC01
+            + q.astype("<u2").tobytes()
+            + b"".join(_varint(int(w)) for w in qw))
+
+
+def decode_q16_centroids(data: bytes):
+    """Inverse of encode_q16_centroids -> (means f32[n], weights
+    f32[n]); ValueError on truncation (poison-pill reject path)."""
+    if len(data) < _Q16_HEAD.size:
+        raise ValueError("truncated q16 centroid row")
+    n, lo, hi = _Q16_HEAD.unpack_from(data, 0)
+    off = _Q16_HEAD.size
+    if len(data) < off + 2 * n:
+        raise ValueError("truncated q16 mean block")
+    # vlint: disable=DR02 reason=inverse of the q16 wire row above —
+    # same single-homed wire codec, not a bank-leaf byte move
+    q = np.frombuffer(data, "<u2", n, off).astype(np.float64)
+    off += 2 * n
+    weights = np.empty(n, np.float64)
+    for i in range(n):
+        w, off = _read_varint(data, off)
+        weights[i] = w / _Q16_WSCALE
+    span = float(hi) - float(lo)
+    if span > 0:
+        means = lo + q * (span / _Q16_GRID)
+    else:
+        means = np.full(n, float(lo), np.float64)
+    return means.astype(np.float32), weights.astype(np.float32)
+
+
+def histogram_wire_fragment(means, weights, codec: str = "lossless"):
+    """The jsonmetric-v1 centroid carrier for one histogram: the
+    lossless [[mean, weight], ...] list under "centroids", or the q16
+    row base64'd under "centroids_q16" (falling back to lossless for a
+    list the codec refuses). Single home of both JSON spellings."""
+    if codec == "q16":
+        try:
+            return {Q16_JSON_KEY: base64.b64encode(
+                encode_q16_centroids(means, weights)).decode("ascii")}
+        except ValueError:
+            pass
+    return {"centroids": [[float(m), float(w)]
+                          for m, w in zip(means, weights)]}
+
+
+def histogram_centroids_from_json(h: dict):
+    """-> (means, weights) from a jsonmetric-v1 histogram dict,
+    whichever carrier it used. The q16 arm raises ValueError on a
+    malformed row (the import path 400s the body, like any other
+    decode failure)."""
+    packed = h.get(Q16_JSON_KEY)
+    if packed is not None:
+        return decode_q16_centroids(base64.b64decode(packed))
+    cents = h.get("centroids", [])
+    means = np.array([c[0] for c in cents], np.float32)
+    weights = np.array([c[1] for c in cents], np.float32)
+    return means, weights
+
+
+def td_centroids(td):
+    """-> (means f32, weights f32) of a metricpb TDigest, whichever
+    row it carries — the ONE decode point for both representations
+    (import apply, export inversion, journal recovery)."""
+    if len(td.packed_centroids):
+        return decode_q16_centroids(td.packed_centroids)
+    return (np.array([c.mean for c in td.centroids], np.float32),
+            np.array([c.weight for c in td.centroids], np.float32))
+
+
+def export_to_metrics(export: ForwardExport,
+                      codec: str = "lossless") -> list:
+    """ForwardExport -> [metricpb.Metric] (the flush-side
+    serialization). `codec` selects the centroid row: "lossless" (the
+    default — repeated Centroid messages, bit-exact) or "q16" (the
+    packed quantized row above; per-metric fallback to lossless when a
+    list refuses quantization)."""
     out = []
     for key, means, weights, vmin, vmax, vsum, count, recip in (
             export.histograms):
@@ -292,9 +510,18 @@ def export_to_metrics(export: ForwardExport) -> list:
         td = m.histogram.t_digest
         td.min, td.max, td.sum = float(vmin), float(vmax), float(vsum)
         td.count, td.reciprocal_sum = float(count), float(recip)
-        for mean, w in zip(np.asarray(means), np.asarray(weights)):
-            if w > 0:
-                td.centroids.add(mean=float(mean), weight=float(w))
+        packed = None
+        if codec == "q16":
+            try:
+                packed = encode_q16_centroids(means, weights)
+            except ValueError:
+                packed = None
+        if packed is not None:
+            td.packed_centroids = packed
+        else:
+            for mean, w in zip(np.asarray(means), np.asarray(weights)):
+                if w > 0:
+                    td.centroids.add(mean=float(mean), weight=float(w))
         out.append(m)
     for key, regs in export.sets:
         m = metric_pb2.Metric(name=key.name,
@@ -332,9 +559,7 @@ def export_from_metrics(metrics) -> ForwardExport:
         which = m.WhichOneof("value")
         if which == "histogram":
             td = m.histogram.t_digest
-            means = np.array([c.mean for c in td.centroids], np.float32)
-            weights = np.array([c.weight for c in td.centroids],
-                               np.float32)
+            means, weights = td_centroids(td)
             export.histograms.append(
                 (key, means, weights, td.min, td.max, td.sum, td.count,
                  td.reciprocal_sum))
@@ -361,8 +586,7 @@ def apply_metric_to_engine(engine, m) -> None:
     which = m.WhichOneof("value")
     if which == "histogram":
         td = m.histogram.t_digest
-        means = np.array([c.mean for c in td.centroids], np.float32)
-        weights = np.array([c.weight for c in td.centroids], np.float32)
+        means, weights = td_centroids(td)
         engine.import_histogram(key, means, weights, td.min, td.max,
                                 td.sum, td.count, td.reciprocal_sum)
     elif which == "set":
@@ -384,8 +608,7 @@ def apply_metric_to_engine_locked(engine, m) -> None:
     which = m.WhichOneof("value")
     if which == "histogram":
         td = m.histogram.t_digest
-        means = np.array([c.mean for c in td.centroids], np.float32)
-        weights = np.array([c.weight for c in td.centroids], np.float32)
+        means, weights = td_centroids(td)
         engine._import_histogram_locked(
             key, means, weights, td.min, td.max, td.sum, td.count,
             td.reciprocal_sum)

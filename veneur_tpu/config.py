@@ -70,6 +70,28 @@ class Config:
     forward_use_grpc: bool = True
     consul_forward_service_name: str = ""
     consul_refresh_interval: str = "30s"
+    # --- wire compression (ISSUE 13; README "Wire compression") ---
+    # Delta forwarding: each interval ships only the sketches the
+    # dirty-slot bitmap saw touched (idle counter zeros / empty set
+    # register banks stay home), with a periodic full resync and a
+    # receiver-side gap check — a delta above a missed seq is refused
+    # loudly (HTTP 409 / FAILED_PRECONDITION "delta-over-gap") and the
+    # sender falls back to a full resync, so exactly-once still holds.
+    # On by default: deltas are lossless for touched keys; the only
+    # trade is that IDLE keys refresh the global's series liveness
+    # once per resync instead of every interval.
+    forward_delta: bool = True
+    # every Nth forwarded interval is a full resync (re-ships every
+    # active key, idle ones included); demotions/gap refusals force
+    # one sooner. >= 1.
+    forward_full_resync_intervals: int = 60
+    # Centroid wire row: "lossless" (default — repeated f64 centroid
+    # pairs, bit-exact) | "q16" (u16 affine-scaled means + varint
+    # 1/8-fixed-point weights, ~4-5x smaller at bounded quantization
+    # error; exact count/sum/min/max unaffected). Folded into the
+    # engine/wire stamp ("h=tdigest/1q") so BOTH ends of a forwarding
+    # pair must agree — a mixed fleet rejects loudly before decode.
+    forward_centroid_codec: str = "lossless"
 
     # --- egress resilience (veneur_tpu/resilience.py) ---
     # Per-attempt socket timeout for every network egress (sinks +
@@ -430,9 +452,16 @@ def _validate(cfg: Config) -> None:
     for key in ("retry_max_attempts", "breaker_failure_threshold",
                 "breaker_half_open_successes", "spill_max_intervals",
                 "forward_dedupe_max_seqs_per_sender",
-                "forward_dedupe_max_senders"):
+                "forward_dedupe_max_senders",
+                "forward_full_resync_intervals"):
         if getattr(cfg, key) < 1:
             raise ValueError(f"{key} must be >= 1")
+    if cfg.forward_centroid_codec not in ("lossless", "q16"):
+        raise ValueError(
+            "forward_centroid_codec must be lossless or q16, got "
+            f"{cfg.forward_centroid_codec!r} (both ends of a "
+            "forwarding pair must run the same codec — it is part of "
+            "the engine/wire stamp)")
     if cfg.flight_recorder_ticks < 1 or \
             cfg.flight_recorder_max_phases < 8:
         raise ValueError(

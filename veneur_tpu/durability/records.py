@@ -182,15 +182,24 @@ def decode_meta(data: bytes):
 
 
 def encode_begin(seq: int, chunk_offset: int, chunk_count: int,
-                 age: int, export: ForwardExport) -> bytes:
+                 age: int, export: ForwardExport,
+                 kind: str = "full") -> bytes:
+    """The kind byte (0 = full, 1 = delta) trails the export payload:
+    a parked interval replays under its ORIGINAL full/delta marker
+    after a crash (a recovered delta re-stamped full would silently
+    reset the receiver's gap baseline). Trailing keeps pre-ISSUE-13
+    journals decodable — an absent byte reads as "full", which every
+    pre-delta interval was."""
     return _BEGIN_HEAD.pack(seq, chunk_offset, chunk_count, age) \
-        + encode_export(export)
+        + encode_export(export) \
+        + (b"\x01" if kind == "delta" else b"\x00")
 
 
 def decode_begin(data: bytes):
     seq, chunk_offset, chunk_count, age = _BEGIN_HEAD.unpack_from(data, 0)
-    export, _ = decode_export(data, _BEGIN_HEAD.size)
-    return seq, chunk_offset, chunk_count, age, export
+    export, off = decode_export(data, _BEGIN_HEAD.size)
+    kind = "delta" if (off < len(data) and data[off] == 1) else "full"
+    return seq, chunk_offset, chunk_count, age, export, kind
 
 
 def encode_done(seq: int) -> bytes:

@@ -57,11 +57,11 @@ class ForwardJournal:
                             records.encode_meta(sender_id, next_seq))
 
     def begin(self, seq: int, chunk_offset: int, chunk_count: int,
-              age: int, export):
+              age: int, export, kind: str = "full"):
         self.journal.append(
             records.REC_BEGIN,
             records.encode_begin(seq, chunk_offset, chunk_count, age,
-                                 export))
+                                 export, kind))
 
     def done(self, seq: int):
         self.journal.append(records.REC_DONE, records.encode_done(seq))

@@ -55,9 +55,12 @@ def json_metric_to_pb(d: dict) -> metric_pb2.Metric:
     if mtype in ("histogram", "timer"):
         h = d["histogram"]
         td = m.histogram.t_digest
-        for c in h.get("centroids", []):
-            if float(c[1]) > 0:
-                td.centroids.add(mean=float(c[0]), weight=float(c[1]))
+        # both centroid carriers decode through wire.py (WC01): the
+        # lossless [[mean, weight]] list or the q16 packed row
+        means, weights = wire.histogram_centroids_from_json(h)
+        for mean, w in zip(means, weights):
+            if float(w) > 0:
+                td.centroids.add(mean=float(mean), weight=float(w))
         td.min = float(h.get("min", 0.0))
         td.max = float(h.get("max", 0.0))
         td.sum = float(h.get("sum", 0.0))
@@ -265,6 +268,22 @@ class HttpApi:
                                          b"mismatch\n")
                         return
                     obs_kw["stamp"] = remote
+                # delta-over-gap refusal (ISSUE 13): a delta chunk may
+                # only apply over an unbroken per-sender seq chain —
+                # checked from the HEADERS, before any body decode,
+                # like the stamp gate. 409 + the marker body is the
+                # wire shape the sender's fallback recognizes (spill
+                # the payload, force a full resync); the refused delta
+                # was never applied so nothing is lost or doubled.
+                if (env is not None and api._ledger is not None
+                        and wire.forward_kind_from_headers(self.headers)
+                        == "delta"
+                        and not api._ledger.check_delta(env[0], env[1])):
+                    self._reply(409, json.dumps(
+                        {"error": wire.DELTA_GAP_DETAIL,
+                         "sender": env[0], "seq": env[1]}).encode(),
+                        "application/json")
+                    return
                 if api._merge_sketches is not None:
                     raw = self.headers.get(wire.PREFIX_SKETCH_HEADER)
                     if raw:

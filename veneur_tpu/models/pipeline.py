@@ -614,6 +614,18 @@ class ForwardExport:
     # global tier (overload-defense satellite): [(prefix, bytes regs)];
     # merge-by-max, advisory — excluded from the durability journal
     prefix_sketches: list = dc_field(default_factory=list)
+    # What this export IS (ISSUE 13 delta forwarding): "full" = the
+    # sender's COMPLETE interned counter/set key set (idle keys ship
+    # their zero totals / empty register banks — the receiver-liveness
+    # refresh a resync exists for); "delta" = only the keys the
+    # dirty-slot bitmap saw land this interval. Histograms and gauges
+    # are touched-only under EITHER kind, deliberately: a zero-count
+    # histogram row would be live-filtered out of the receiver's own
+    # flush anyway (pure wire waste), and a synthetic zero gauge would
+    # CLOBBER the receiver's last-write-wins state. The forwarder
+    # stamps the kind onto the interval's envelope so the receiver can
+    # gap-check deltas.
+    kind: str = "full"
 
 
 class FlushResult:
@@ -1789,16 +1801,26 @@ class AggregationEngine:
             self._seng.estimate_finalize(host)
         return host
 
-    def _flush_bookkeeping(self) -> tuple:
+    def _flush_bookkeeping(self, full_export: bool = False) -> tuple:
         """Under the lock, at the tick boundary: snapshot the active
         key sets and per-interval counters, reset them, and advance
-        the interner intervals — shared by both flush orderings."""
+        the interner intervals — shared by both flush orderings.
+
+        `full_export` (a FULL-kind forward build, ISSUE 13)
+        additionally snapshots the counter/set interners' COMPLETE
+        tables: the resync ships idle keys' zero/empty rows to refresh
+        the receiving tier's series liveness. Snapshotted here, under
+        the same lock hold as the active sets, so the full export and
+        the bank snapshot describe the same instant."""
         active = {
             "histo": self.histo_keys.active_items(),
             "counter": self.counter_keys.active_items(),
             "gauge": self.gauge_keys.active_items(),
             "set": self.set_keys.active_items(),
         }
+        if full_export:
+            active["counter_all"] = self.counter_keys.all_items()
+            active["set_all"] = self.set_keys.all_items()
         status, self._status = self._status, {}
         stats_samples = self.samples_processed
         self.samples_processed = 0
@@ -1849,9 +1871,22 @@ class AggregationEngine:
             cb, gb, counters, gauges, dirty, gauge_seq)
         return hb, cb, gb, sb
 
-    def flush(self, timestamp: int | None = None) -> FlushResult:
+    def flush(self, timestamp: int | None = None,
+              forward_kind: str = "full") -> FlushResult:
         """The Server.Flush equivalent: snapshot banks, run the merge
         program, assemble InterMetrics + forward exports, reset state.
+
+        `forward_kind` (ISSUE 13): "delta" asks the export build to
+        consume the retired dirty-slot bitmap — the THIRD consumer,
+        after the incremental flush and the delta checkpoints, under
+        the same retire discipline — and ship only touched counter/set
+        rows (histograms and gauges are touched-only either way, see
+        ForwardExport.kind). Honored only when the bitmap exists
+        (dirty tracking armed, not the mesh engine) and this engine
+        forwards; the result's export.kind records what was actually
+        built, so the forwarder stamps the envelope truthfully. The
+        locally-flushed frame is NEVER delta-filtered — only the
+        forward path is byte-bound.
 
         Double-buffered (the default): the lock is held ONLY across
         the retire-and-swap — stage buffers, staged imports, banks and
@@ -1864,6 +1899,7 @@ class AggregationEngine:
         drain+land under the lock before the swap, as before."""
         ts = int(timestamp if timestamp is not None else time.time())
         cfg = self.cfg
+        full_export = self._fwd_out and forward_kind != "delta"
         t_start = time.monotonic_ns()
         if self._use_double_buffer:
             with self.lock:
@@ -1887,7 +1923,7 @@ class AggregationEngine:
                 snap = self._swap_banks()
                 dirty = self._retire_dirty()
                 (active, status, stats_samples, dropped,
-                 histo_key_count) = self._flush_bookkeeping()
+                 histo_key_count) = self._flush_bookkeeping(full_export)
             t_swap = time.monotonic_ns()
             # flight-recorder stamps: (name, t0_ns, t1_ns) on the
             # shared monotonic_ns clock, returned in stats["phases"]
@@ -1907,7 +1943,7 @@ class AggregationEngine:
                 dirty = self._retire_dirty()
                 self._gauge_seq = 0
                 (active, status, stats_samples, dropped,
-                 histo_key_count) = self._flush_bookkeeping()
+                 histo_key_count) = self._flush_bookkeeping(full_export)
             t_swap = time.monotonic_ns()
             phases = [("drain", t_start, t_swap)]
 
@@ -1915,8 +1951,15 @@ class AggregationEngine:
         host = self._flush_device(snap, phases=phases, dirty=dirty)
         t_device = time.monotonic_ns()
 
+        # Delta export build (ISSUE 13): honor the request only when
+        # the retired bitmap exists — it travels with exactly the bank
+        # snapshot this assembly reads, so "dirty" and "this
+        # interval's rows" can never skew.
+        want_delta = (forward_kind == "delta" and fwd_out
+                      and dirty is not None)
         frame = MetricFrame(ts, cfg.hostname)
-        export = ForwardExport(set_engine=self._seng.id)
+        export = ForwardExport(set_engine=self._seng.id,
+                               kind="delta" if want_delta else "full")
 
         # ---- histograms: vectorized gathers over the active set ----
         infos = active["histo"]
@@ -1986,17 +2029,33 @@ class AggregationEngine:
 
         # ---- counters ----
         infos = active["counter"]
+        all_infos = active.get("counter_all")
+        c_tot = None
+        if infos or (fwd_out and all_infos):
+            c_tot = (np.asarray(host["c_hi"], np.float64)
+                     + np.asarray(host["c_lo"], np.float64))
         if infos:
             n = len(infos)
             slots = np.fromiter((t[1] for t in infos), np.int64, n)
-            totals = (np.asarray(host["c_hi"], np.float64)
-                      + np.asarray(host["c_lo"], np.float64))[slots]
+            totals = c_tot[slots]
             keep = range(n)
             if fwd_out:
                 scopes = np.fromiter((t[2] for t in infos), np.int64, n)
                 gm = scopes == GLOBAL_ONLY
-                for i in np.nonzero(gm)[0].tolist():
-                    export.counters.append((infos[i][0], float(totals[i])))
+                if want_delta:
+                    # DELTA wire: only counters the dirty bitmap saw
+                    # land this interval. `keep` (the local frame)
+                    # stays scope-driven — delta filters the WIRE,
+                    # never re-scopes a key into the local flush.
+                    em = gm & dirty[1][slots]
+                elif all_infos is not None:
+                    em = None   # FULL: exported from the whole table
+                else:
+                    em = gm     # no full table (mesh): touched set
+                if em is not None:
+                    for i in np.nonzero(em)[0].tolist():
+                        export.counters.append(
+                            (infos[i][0], float(totals[i])))
                 keep = np.nonzero(~gm)[0].tolist()
             keep = list(keep)
             if keep:
@@ -2004,6 +2063,14 @@ class AggregationEngine:
                     [infos[i][0].name for i in keep],
                     [self._scalar_tags_of(infos[i]) for i in keep],
                     totals[keep], (MetricType.COUNTER,))
+        if fwd_out and not want_delta and all_infos:
+            # FULL resync: every interned global-only counter ships,
+            # idle zeros included — the receiver-liveness refresh a
+            # steady-state delta deliberately skips. Wire only; the
+            # local frame above stays touched-keys-only.
+            for key, slot, scope, _h in all_infos:
+                if scope == GLOBAL_ONLY:
+                    export.counters.append((key, float(c_tot[slot])))
 
         # ---- gauges ----
         infos = active["gauge"]
@@ -2028,6 +2095,7 @@ class AggregationEngine:
 
         # ---- sets ----
         infos = active["set"]
+        all_infos = active.get("set_all")
         if infos:
             n = len(infos)
             slots = np.fromiter((t[1] for t in infos), np.int64, n)
@@ -2036,9 +2104,20 @@ class AggregationEngine:
             if fwd_out:
                 scopes = np.fromiter((t[2] for t in infos), np.int64, n)
                 fm = scopes != LOCAL_ONLY
-                for i in np.nonzero(fm)[0].tolist():
-                    export.sets.append(
-                        (infos[i][0], host["s_regs"][infos[i][1]]))
+                if want_delta:
+                    # untouched set slots hold all-zero registers —
+                    # the single biggest idle-key wire cost (a full
+                    # register bank per key per interval); a delta
+                    # ships only touched ones. Local frame unchanged.
+                    em = fm & dirty[3][slots]
+                elif all_infos is not None:
+                    em = None   # FULL: exported from the whole table
+                else:
+                    em = fm
+                if em is not None:
+                    for i in np.nonzero(em)[0].tolist():
+                        export.sets.append(
+                            (infos[i][0], host["s_regs"][infos[i][1]]))
                 keep = np.nonzero(~fm)[0].tolist()
             keep = list(keep)
             if keep:
@@ -2046,6 +2125,13 @@ class AggregationEngine:
                     [infos[i][0].name for i in keep],
                     [self._scalar_tags_of(infos[i]) for i in keep],
                     ests[keep], (MetricType.GAUGE,))
+        if fwd_out and not want_delta and all_infos:
+            # FULL resync: every interned non-local set ships its
+            # registers (idle = all-zero banks, a merge no-op that
+            # keeps the key alive at the receiver)
+            for key, slot, scope, _h in all_infos:
+                if scope != LOCAL_ONLY:
+                    export.sets.append((key, host["s_regs"][slot]))
 
         # ---- status checks (StatusCheck sampler flush shape) ----
         status_metrics = [
@@ -2078,6 +2164,10 @@ class AggregationEngine:
             # counts) — bench/test introspection, also what an
             # operator correlates the gather/scatter phases against
             "flush_path": dict(self._last_flush_info),
+            # what the export build actually shipped (delta requests
+            # degrade to full when no bitmap exists — mesh, tracking
+            # off — or the engine does not forward)
+            "forward_kind": export.kind,
         }
         return FlushResult(frame=frame, export=export, stats=stats,
                            status_metrics=status_metrics)

@@ -96,6 +96,16 @@ class KeyInterner:
         return [(k, i.slot, i.scope, i) for k, i in self._map.items()
                 if i.last_interval == cur]
 
+    def all_items(self):
+        """EVERY interned key, touched or idle (same row shape as
+        active_items) — what a FULL forward resync ships (ISSUE 13):
+        idle keys' zero/empty bank rows refresh the receiving tier's
+        series liveness, which steady-state deltas deliberately skip.
+        Keys idle past the TTL have already evicted and are gone from
+        here too — a resync re-ships the interner's world, not
+        history."""
+        return [(k, i.slot, i.scope, i) for k, i in self._map.items()]
+
     def snapshot_entries(self) -> list:
         """The full table as (slot, scope, last_interval, name, type,
         joined_tags) rows — the engine checkpoint's ENGINE_KEYS payload

@@ -124,6 +124,15 @@ class TelemetryRegistry:
         with self._lock:
             return self._cumulative.get((scope, name), 0)
 
+    def totals_by_name_prefix(self, prefix: str) -> dict:
+        """{(scope, name): cumulative} for every counter whose name
+        starts with `prefix` — scrape surfaces (the /debug/fleet
+        forward-bytes block) read destination-scoped families without
+        knowing the destination strings up front."""
+        with self._lock:
+            return {(s, n): v for (s, n), v in self._cumulative.items()
+                    if n.startswith(prefix)}
+
     # ---- gauges (last-write-wins, cleared on drain) ----
 
     def set_gauge(self, scope: str, name: str, value: float):

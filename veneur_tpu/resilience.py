@@ -111,6 +111,19 @@ class PartialDeliveryError(EgressError):
         self.chunk_count = chunk_count
 
 
+class DeltaGapRefusedError(TerminalEgressError):
+    """The receiver refused a DELTA chunk because the sender's seq
+    chain has a gap below it (or the receiver has no baseline for this
+    sender at all — a restart without durable watermarks). Raised by
+    the leaf forwarders when they recognize the refusal on the wire
+    (HTTP 409 / gRPC FAILED_PRECONDITION "delta-over-gap"); the
+    ResilientForwarder catches it and, instead of parking a delta that
+    would be refused forever, spills the payload into the merged
+    overflow tier and forces the next interval to be a FULL resync —
+    the refused delta was never applied (refusal precedes decode), so
+    no data is lost and nothing double-counts."""
+
+
 class HTTPStatusError(EgressError):
     """A transport returned an HTTP error status without raising (fake
     transports and non-urllib stacks); retryability follows the code."""
@@ -183,7 +196,13 @@ class ForwardEnvelope:
     receiver's import spans parent on the remote flush — and the
     interval-close wall time feeding the global's e2e latency. Zeros
     mean "no context" (recorder off, legacy sender) and encode to
-    nothing; the dedupe path never reads them."""
+    nothing; the dedupe path never reads them.
+
+    `kind` is the delta-forwarding marker (ISSUE 13): "full" (the
+    complete active sketch set — encodes to NOTHING, so legacy wire
+    chunks stay byte-identical) or "delta" (only the sketches the
+    dirty-slot bitmap saw touched this interval; the receiver applies
+    it only over an unbroken seq chain)."""
 
     sender_id: str
     interval_seq: int
@@ -192,6 +211,7 @@ class ForwardEnvelope:
     trace_id: int = 0
     span_id: int = 0
     close_ns: int = 0
+    kind: str = "full"
 
 
 def accepts_envelope(fn) -> bool:
@@ -653,18 +673,23 @@ class SpillBuffer:
 
     def merge_into(self, export):
         """Merge everything pending into `export` (in place) and clear.
-        Spilled gauges PREPEND so the current interval's fresher value
-        wins last-write-wins at the receiver; sketch types append —
-        the receiver's Combine path merges same-key entries anyway.
-        Gauge ages are remembered so that if THIS export fails too, the
-        re-spill continues them (reset unconditionally: a successful
-        delivery must not leak ages onto later fresh values)."""
+        Spilled entries PREPEND — they are strictly OLDER than the
+        current interval's, and the receiver's import landing clusters
+        piles in arrival order, so chronological order keeps a
+        spill-carrying interval's merge as close as possible to what
+        separate in-order deliveries would have produced (exactly what
+        the delta gap-fallback's bit-identity probe pins; for gauges
+        prepending is also what makes the current interval's fresher
+        value win last-write-wins at the receiver). Gauge ages are
+        remembered so that if THIS export fails too, the re-spill
+        continues them (reset unconditionally: a successful delivery
+        must not leak ages onto later fresh values)."""
         self._merged_gauge_ages = {key: age for key, (_v, age)
                                    in self._gauges.items()}
         if not len(self):
             return export
         n = len(self)
-        export.histograms.extend(
+        export.histograms[:0] = (
             (key, h[0], h[1], h[2], h[3], h[4], h[5], h[6])
             for key, h in self._histos.items())
         if self._sets and self.set_engine != getattr(
@@ -684,8 +709,8 @@ class SpillBuffer:
                 getattr(export, "set_engine", "hll"))
             n -= len(self._sets)
         else:
-            export.sets.extend(self._sets.items())
-        export.counters.extend(self._counters.items())
+            export.sets[:0] = self._sets.items()
+        export.counters[:0] = self._counters.items()
         export.gauges[:0] = [(key, v) for key, (v, _a)
                              in self._gauges.items()]
         self._histos, self._sets = {}, {}
@@ -706,10 +731,10 @@ class _ReplayEntry:
     receiver's ledger can drop a chunk that was ambiguously applied."""
 
     __slots__ = ("seq", "chunk_offset", "chunk_count", "export", "age",
-                 "close_ns")
+                 "close_ns", "kind")
 
     def __init__(self, seq, export, chunk_offset=0, chunk_count=0,
-                 close_ns=0):
+                 close_ns=0, kind="full"):
         self.seq = seq
         self.export = export
         self.chunk_offset = chunk_offset
@@ -721,6 +746,13 @@ class _ReplayEntry:
         # global's e2e latency honestly includes replay-ladder delay.
         # 0 = unknown (journal-recovered entries; e2e is skipped).
         self.close_ns = close_ns
+        # the full/delta kind the interval was BUILT as, pinned for its
+        # whole ladder life: a replay re-declares what the payload IS,
+        # not what the current tick would build (a delta re-stamped as
+        # full would skip the receiver's gap check while still only
+        # carrying the touched subset — harmless to merge, but it
+        # would silently reset the gap baseline the check rides on).
+        self.kind = kind
 
 
 class ResilientForwarder:
@@ -756,6 +788,8 @@ class ResilientForwarder:
                  replay_budget_s: float | None = None,
                  clock=time.monotonic,
                  journal=None,
+                 delta_enabled: bool = True,
+                 full_resync_intervals: int = 60,
                  registry: ResilienceRegistry | None = None):
         """`seq_start` seeds the interval_seq space. Auto-generated
         sender ids are unique per process incarnation, so they start at
@@ -802,6 +836,20 @@ class ResilientForwarder:
         self._clock = clock
         self._takes_envelope = accepts_envelope(inner)
         self._next_seq = seq_start if seq_start is not None else 1
+        # Delta forwarding (ISSUE 13): next_forward_kind() tells the
+        # flush what to build. The FIRST interval of an incarnation is
+        # always full (the receiver has no seq baseline for this
+        # sender yet); thereafter deltas flow until a periodic resync
+        # is due (`full_resync_intervals` — re-ships idle keys so the
+        # global's series liveness refreshes) or a resync is FORCED:
+        # a ladder demotion re-envelopes an interval, punching a hole
+        # in the seq chain a receiver must never apply a delta over,
+        # and a receiver's delta-over-gap refusal means its baseline
+        # is gone — both set _force_full.
+        self.delta_enabled = bool(delta_enabled)
+        self.full_resync_intervals = max(0, int(full_resync_intervals))
+        self._force_full = True
+        self._since_full = 0
         self._entries: list[_ReplayEntry] = []
         self.spill = SpillBuffer(
             max_sketches=max_spill_sketches,
@@ -902,8 +950,9 @@ class ResilientForwarder:
             self.sender_id = sender_id
             self._next_seq = max(self._next_seq, next_seq)
         elif rec_type == drec.REC_BEGIN:
-            seq, off, cnt, age, export = drec.decode_begin(payload)
-            entry = _ReplayEntry(seq, export, off, cnt)
+            seq, off, cnt, age, export, kind = \
+                drec.decode_begin(payload)
+            entry = _ReplayEntry(seq, export, off, cnt, kind=kind)
             entry.age = age
             self._entries.append(entry)
             self._next_seq = max(self._next_seq, seq + 1)
@@ -942,7 +991,7 @@ class ResilientForwarder:
         out.extend(
             (drec.REC_BEGIN,
              drec.encode_begin(e.seq, e.chunk_offset, e.chunk_count,
-                               e.age, e.export))
+                               e.age, e.export, e.kind))
             for e in self._entries)
         return out
 
@@ -963,6 +1012,25 @@ class ResilientForwarder:
         return sum(_export_size(e.export) for e in self._entries) \
             + len(self.spill)
 
+    def next_forward_kind(self) -> str:
+        """What the NEXT interval's export build should be: "delta"
+        (only dirty-bitmap-touched sketches) or "full" (the complete
+        active set — the first interval, every `full_resync_intervals`
+        thereafter, after any ladder demotion or receiver gap refusal,
+        and always when the inner forwarder rotates across multiple
+        destinations, where no single receiver sees a contiguous seq
+        chain). Read-only: the resync bookkeeping advances in
+        __call__, when an interval of that kind actually enters the
+        ladder — an idle tick must not eat a scheduled resync."""
+        if not self.delta_enabled or self._force_full:
+            return "full"
+        if not getattr(self.inner, "delta_capable", True):
+            return "full"
+        if self.full_resync_intervals and \
+                self._since_full + 1 >= self.full_resync_intervals:
+            return "full"
+        return "delta"
+
     def _send(self, export, envelope: ForwardEnvelope):
         if self._takes_envelope:
             self.inner(export, envelope=envelope)
@@ -970,16 +1038,34 @@ class ResilientForwarder:
             self.inner(export)
 
     def _park(self, seq, export, chunk_offset=0, chunk_count=0,
-              close_ns=0):
+              close_ns=0, kind="full"):
         n = _export_size(export)
         if n == 0:
             return 0
         self._entries.append(
             _ReplayEntry(seq, export, chunk_offset, chunk_count,
-                         close_ns))
+                         close_ns, kind))
         self.registry.incr(self.destination, "spilled", n)
         self._enforce_ledger_budget()
         return n
+
+    def _demote_front_to_spill(self, counter: str):
+        """Move the OLDEST ladder entry into the merged overflow tier
+        (the one demotion shape the REC_DEMOTE journal op replays).
+        Punches a permanent hole in the seq chain — that seq will
+        never be delivered under its own envelope — so the next
+        interval is forced to a full resync: a receiver must never be
+        asked to apply a delta over the gap."""
+        entry = self._entries.pop(0)
+        self.registry.incr(self.destination, counter,
+                           _export_size(entry.export))
+        self._jop("demote")
+        # SpillBuffer.spill counts these under "spilled" again;
+        # compensate so spilled_total keeps meaning "sketches that
+        # entered the resilience layer", not internal shuffles
+        added = self.spill.spill(entry.export)
+        self.registry.incr(self.destination, "spilled", -added)
+        self._force_full = True
 
     def _enforce_ledger_budget(self):
         """Demote oldest entries to the merged overflow tier until the
@@ -989,15 +1075,7 @@ class ResilientForwarder:
         while self._entries and (
                 len(self._entries) > self.max_spill_intervals
                 or total() > self.max_spill_sketches):
-            entry = self._entries.pop(0)
-            self.registry.incr(self.destination, "reenveloped",
-                               _export_size(entry.export))
-            self._jop("demote")
-            # SpillBuffer.spill counts these under "spilled" again;
-            # compensate so spilled_total keeps meaning "sketches that
-            # entered the resilience layer", not internal shuffles
-            added = self.spill.spill(entry.export)
-            self.registry.incr(self.destination, "spilled", -added)
+            self._demote_front_to_spill("reenveloped")
 
     def _age_entries(self):
         """One failed flush elapsed with these entries still pending:
@@ -1013,10 +1091,31 @@ class ResilientForwarder:
                 entry.export.gauges[:] = []
                 if _export_size(entry.export) == 0:
                     self._entries.remove(entry)
+                    # the emptied entry's seq will never be delivered —
+                    # a hole in the chain, so the next interval must be
+                    # a full resync (same rule as a demotion; without
+                    # this every later delta eats one avoidable
+                    # refusal round-trip)
+                    self._force_full = True
         self.registry.incr(self.destination, "spill_evicted", evicted)
+
+    def _note_interval_kind(self, kind: str):
+        """Resync bookkeeping, called once per interval that entered
+        the ladder or the wire: a FULL interval (even one merely
+        parked — it replays under its pinned kind and delivers
+        eventually) restarts the resync countdown; a delta advances
+        it."""
+        if kind == "full":
+            self._force_full = False
+            self._since_full = 0
+        else:
+            self._since_full += 1
 
     def __call__(self, export):
         reg, dest = self.registry, self.destination
+        # what the engine actually built this interval ("full" unless
+        # the flush consumed the dirty bitmap at the server's request)
+        cur_kind = getattr(export, "kind", "full")
         replay_err = None
         # fleet-tracing context from the tick in progress: every wire
         # chunk this call emits (replays included) is stamped with the
@@ -1040,7 +1139,7 @@ class ResilientForwarder:
         if self._journal is not None and _export_size(export):
             cur_seq = self._next_seq
             self._next_seq += 1
-            self._jop("begin", cur_seq, 0, 0, 0, export)
+            self._jop("begin", cur_seq, 0, 0, 0, export, cur_kind)
         # -- replay phase: pending intervals first, oldest seq first,
         # under their ORIGINAL envelopes; stop at the first failure so
         # the receiver observes seqs strictly in order.
@@ -1059,7 +1158,8 @@ class ResilientForwarder:
             env = ForwardEnvelope(self.sender_id, entry.seq,
                                   entry.chunk_offset, entry.chunk_count,
                                   trace_id=trace_id, span_id=span_id,
-                                  close_ns=entry.close_ns)
+                                  close_ns=entry.close_ns,
+                                  kind=entry.kind)
             sc = _current_scope()
             tick = sc.tick if sc is not None else None
             rp = -1 if tick is None else \
@@ -1068,6 +1168,27 @@ class ResilientForwarder:
                 tick.annotate(rp, seq=entry.seq)
             try:
                 self._send(entry.export, env)
+            except DeltaGapRefusedError:
+                # the receiver has no unbroken chain below this delta
+                # (its baseline died — restart without watermarks — or
+                # an earlier demotion holed the chain). Parking it for
+                # replay would be a livelock: the same delta refused
+                # forever. Its data is intact (refusal precedes any
+                # apply), so demote it to the merged tier — it rides
+                # the NEXT interval, which _demote_front_to_spill just
+                # forced to a full resync — and keep draining the
+                # ladder (later deltas above the same gap fall back
+                # the same way).
+                if tick is not None:
+                    tick.finish(rp, outcome="delta_gap")
+                reg.incr(dest, "delta_gap_refused")
+                log.warning(
+                    "forward to %s: receiver refused delta seq %d over "
+                    "a seq gap; payload re-routed through the overflow "
+                    "tier, next interval forced to a full resync",
+                    dest, entry.seq)
+                self._demote_front_to_spill("delta_gap_fallback")
+                continue
             except PartialDeliveryError as e:
                 entry.export = e.undelivered
                 entry.chunk_offset += e.delivered_chunks
@@ -1097,7 +1218,9 @@ class ResilientForwarder:
                 if cur_seq is None:
                     cur_seq = self._next_seq
                     self._next_seq += 1
-                self._park(cur_seq, export, close_ns=cur_close)
+                self._park(cur_seq, export, close_ns=cur_close,
+                           kind=cur_kind)
+                self._note_interval_kind(cur_kind)
             self._age_entries()
             self._jop("age")
             log.warning(
@@ -1120,7 +1243,7 @@ class ResilientForwarder:
             # the interval only materialized from the spill tier (or
             # journaling is off); write it ahead now
             if self._journal is not None:
-                self._jop("begin", cur_seq, 0, 0, 0, export)
+                self._jop("begin", cur_seq, 0, 0, 0, export, cur_kind)
         elif had_spill:
             # the spill merge changed the written-ahead payload
             self._jop("update", cur_seq, 0, 0, export)
@@ -1134,7 +1257,31 @@ class ResilientForwarder:
         try:
             self._send(export, ForwardEnvelope(
                 self.sender_id, seq, trace_id=trace_id,
-                span_id=span_id, close_ns=cur_close))
+                span_id=span_id, close_ns=cur_close, kind=cur_kind))
+        except DeltaGapRefusedError:
+            # same fallback as the replay arm: the refused delta was
+            # never applied, so its payload spills to the merged tier
+            # and rides the next interval — which the demotion forces
+            # to a full resync. NOT re-raised: nothing was lost, the
+            # counters carry the signal (delta_gap_refused/_fallback).
+            if tick is not None:
+                tick.finish(sp, outcome="delta_gap")
+            reg.incr(dest, "delta_gap_refused")
+            self._park(seq, export, close_ns=cur_close, kind=cur_kind)
+            if self._entries and self._entries[0].seq == seq:
+                self._demote_front_to_spill("delta_gap_fallback")
+            else:
+                # _park's budget enforcement already demoted the entry
+                # (an export past max_spill_sketches) — the demotion
+                # counted it as reenveloped and the resync must still
+                # be forced
+                self._force_full = True
+            log.warning(
+                "forward to %s: receiver refused delta seq %d over a "
+                "seq gap (no baseline for sender %s); payload rides "
+                "the next interval's full resync", dest, seq,
+                self.sender_id)
+            return
         except PartialDeliveryError as e:
             # some chunks landed: park only what didn't, resuming at
             # the failed chunk's id. The UPDATE record goes first so
@@ -1147,7 +1294,8 @@ class ResilientForwarder:
             n = self._park(seq, e.undelivered,
                            chunk_offset=e.delivered_chunks,
                            chunk_count=e.chunk_count,
-                           close_ns=cur_close)
+                           close_ns=cur_close, kind=cur_kind)
+            self._note_interval_kind(cur_kind)
             self._age_entries()
             self._jop("age")
             log.warning(
@@ -1158,7 +1306,9 @@ class ResilientForwarder:
         except Exception as e:
             if tick is not None:
                 tick.finish(sp, outcome=type(e).__name__)
-            n = self._park(seq, export, close_ns=cur_close)
+            n = self._park(seq, export, close_ns=cur_close,
+                           kind=cur_kind)
+            self._note_interval_kind(cur_kind)
             self._age_entries()
             self._jop("age")
             log.warning(
@@ -1168,6 +1318,7 @@ class ResilientForwarder:
         else:
             if tick is not None:
                 tick.finish(sp, outcome="ok")
+            self._note_interval_kind(cur_kind)
             self._jop("done", seq)
 
     def debug_state(self) -> dict:
@@ -1186,6 +1337,7 @@ class ResilientForwarder:
             "ladder": [{"seq": e.seq, "age": e.age,
                         "chunk_offset": e.chunk_offset,
                         "chunk_count": e.chunk_count,
+                        "kind": e.kind,
                         "sketches": _export_size(e.export)}
                        for e in self._entries],
             "spill_sketches": len(self.spill),
@@ -1194,6 +1346,15 @@ class ResilientForwarder:
                               else breaker.state),
             "journal": (None if jrn is None else {
                 "bytes": jrn.size_bytes()}),
+            # delta-forwarding posture (ISSUE 13): what the next
+            # interval will build and why
+            "delta": {
+                "enabled": self.delta_enabled,
+                "next_kind": self.next_forward_kind(),
+                "force_full": self._force_full,
+                "since_full": self._since_full,
+                "full_resync_intervals": self.full_resync_intervals,
+            },
         }
 
     def close(self):

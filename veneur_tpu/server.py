@@ -114,8 +114,14 @@ class Server:
         # forwarded chunk and enforced on every import request — a
         # mixed fleet (peer running different sketch backends) is
         # refused loudly, never silently merged. One stamp per server:
-        # all engines share the config's backends.
-        self.engine_stamp = self.engines[0].engine_stamp
+        # all engines share the config's backends. The forward
+        # centroid codec folds in (ISSUE 13, "h=tdigest/1q"): a
+        # quantized-centroid fleet is a DIFFERENT wire format, and a
+        # lossless peer must be refused before decode, not fed packed
+        # rows it would misread as empty centroid lists.
+        from . import sketches as _sketches
+        self.engine_stamp = _sketches.stamp_with_codec(
+            self.engines[0].engine_stamp, cfg.forward_centroid_codec)
         # Fleet-wide per-prefix cardinality (overload-defense
         # satellite): received Huffman-Bucket sketches merge-by-max
         # here, keyed by prefix; /debug/fleet serves the estimates.
@@ -187,7 +193,8 @@ class Server:
                     cfg.forward_address,
                     timeout_s=cfg.flush_timeout_seconds,
                     egress_policy=self._egress_policy,
-                    engine_stamp=self.engine_stamp)
+                    engine_stamp=self.engine_stamp,
+                    centroid_codec=cfg.forward_centroid_codec)
             else:
                 from .cluster.forward import HttpJsonForwarder
                 forwarder = HttpJsonForwarder(
@@ -195,7 +202,8 @@ class Server:
                     timeout_s=cfg.flush_timeout_seconds,
                     max_per_body=cfg.flush_max_per_body,
                     egress_policy=self._egress_policy,
-                    engine_stamp=self.engine_stamp)
+                    engine_stamp=self.engine_stamp,
+                    centroid_codec=cfg.forward_centroid_codec)
         elif forwarder is None and cfg.consul_forward_service_name:
             # discover the global tier via Consul and re-resolve on the
             # refresh interval (consul.go; Server.RefreshDestinations)
@@ -210,7 +218,8 @@ class Server:
                 timeout_s=cfg.flush_timeout_seconds,
                 max_per_body=cfg.flush_max_per_body,
                 egress_policy=self._egress_policy,
-                engine_stamp=self.engine_stamp)
+                engine_stamp=self.engine_stamp,
+                centroid_codec=cfg.forward_centroid_codec)
         # Durable state (off by default): crash-safe journals for the
         # sender's replay ladder + spill tier and the receiver's dedupe
         # watermarks. Recovery runs HERE, in the constructor — before
@@ -253,6 +262,10 @@ class Server:
                 # stall at most ~3x retry_deadline, not
                 # spill_max_intervals x retry_deadline
                 replay_budget_s=2 * _parse_interval(cfg.retry_deadline),
+                # delta forwarding (ISSUE 13): the flush loop asks
+                # next_forward_kind() what to build each tick
+                delta_enabled=cfg.forward_delta,
+                full_resync_intervals=cfg.forward_full_resync_intervals,
                 # recovery happens inside the constructor: parked
                 # intervals come back with their original envelopes
                 journal=self._forward_journal)
@@ -438,6 +451,9 @@ class Server:
         self._profile_ticks = 0
         self._profile_active = False
         self._last_forward_err = None   # sentry dedupe, under _stats_lock
+        # last interval's forward bytes by destination/kind (sampled
+        # around the forward call each tick; under _stats_lock)
+        self._last_forward_bytes = None
         self._stats_lock = threading.Lock()
         # SSF span pipeline (SpanWorker + SpanSinks)
         self.span_queue: queue.Queue = queue.Queue(
@@ -1781,11 +1797,22 @@ class Server:
         # times; in parallel they pay ~1×. Single engine = no thread.
         results: list = [None] * len(self.engines)
         eng_ph: list = [-1] * len(self.engines)
+        # Delta forwarding (ISSUE 13): ask the forwarder what THIS
+        # interval's export build should be — "delta" (dirty-bitmap
+        # subset) unless a full resync is due/forced or deltas are off.
+        # Engines that cannot honor it (mesh, tracking off) degrade to
+        # full and say so in export.kind.
+        fkind = "full"
+        if self.forwarder is not None:
+            nfk = getattr(self.forwarder, "next_forward_kind", None)
+            if nfk is not None:
+                fkind = nfk()
         ep = -1 if tick is None else tick.start("engine")
         if len(self.engines) == 1:
             eng_ph[0] = -1 if tick is None else \
                 tick.start("engine.flush", ep)
-            results[0] = self.engines[0].flush(timestamp=ts)
+            results[0] = self.engines[0].flush(timestamp=ts,
+                                               forward_kind=fkind)
             if tick is not None:
                 tick.finish(eng_ph[0], engine=0)
         else:
@@ -1794,7 +1821,8 @@ class Server:
                     tick.start("engine.flush", ep)
                 eng_ph[i] = ph
                 try:
-                    results[i] = eng.flush(timestamp=ts)
+                    results[i] = eng.flush(timestamp=ts,
+                                           forward_kind=fkind)
                 except BaseException as e:
                     results[i] = e
                 finally:
@@ -1831,6 +1859,13 @@ class Server:
             ev, ch = eng.drain_events()
             events.extend(ev)
             checks.extend(ch)
+        # the merged interval is a FULL resync only if EVERY engine
+        # actually built one; any delta share makes the whole payload
+        # incomplete, so stamp it delta (which claims less — a safe
+        # under-claim; in practice engines share one config and agree).
+        # The forwarder's resync bookkeeping keys off this.
+        merged_export.kind = ("delta" if any(
+            r.export.kind == "delta" for r in results) else "full")
         if tick is not None:
             tick.finish(ep)
 
@@ -1887,6 +1922,12 @@ class Server:
             # journal phases nest under `forward`, not beside it
             ftok = observe.set_current_tick(tick, fw) \
                 if tick is not None else None
+            # bytes-on-the-wire accounting (ISSUE 13): the leaf
+            # forwarders count veneur.forward.bytes* per delivered
+            # chunk; sample the cumulative totals around the call so
+            # /debug/fleet can show THIS interval's bytes next to e2e
+            bytes_before = resilience.DEFAULT_REGISTRY \
+                .totals_by_name_prefix("forward.bytes")
             try:
                 self.forwarder(merged_export)
                 with self._stats_lock:
@@ -1908,6 +1949,18 @@ class Server:
                     observe.reset_current_tick(ftok)
                 if tick is not None:
                     tick.finish(fw)
+                bytes_after = resilience.DEFAULT_REGISTRY \
+                    .totals_by_name_prefix("forward.bytes")
+                sample = {}
+                for (scope, name), v in bytes_after.items():
+                    d = v - bytes_before.get((scope, name), 0)
+                    if d:
+                        sample.setdefault(scope, {})[name] = d
+                with self._stats_lock:
+                    self._last_forward_bytes = {
+                        "kind": merged_export.kind,
+                        "by_destination": sample,
+                    }
         # durability flush boundary: fsync + compact the forward
         # journal, and record the dedupe ledger's per-sender admitted
         # watermarks (everything admitted up to here rides in flushed
@@ -2327,11 +2380,25 @@ class Server:
                 "breaker_state": fwd_state["breaker_state"],
             }
         obs = self.import_observer
+        # forward bytes (ISSUE 13): cumulative per destination per
+        # kind from the process registry, plus the last interval's
+        # sample — the bytes/interval row an operator reads next to
+        # e2e latency to see what delta/quantized forwarding saves
+        fbytes: dict = {}
+        for (scope, name), v in resilience.DEFAULT_REGISTRY \
+                .totals_by_name_prefix("forward.bytes").items():
+            fbytes.setdefault(scope, {})[name] = v
+        with self._stats_lock:
+            last_bytes = self._last_forward_bytes
         return {
             "now_ns": now_ns,
             "flush_count": self.flush_count,
             "senders": senders,
             "forward": forward,
+            "forward_bytes": {
+                "cumulative": fbytes,
+                "last_interval": last_bytes,
+            },
             # mixed-fleet visibility (ISSUE 10): this server's engine
             # stamp next to each sender's declared stamp above, plus
             # the mismatch-reject total

@@ -86,13 +86,29 @@ def engine_stamp(heng, seng) -> str:
             f"s={seng.id}/{seng.wire_version}")
 
 
+def stamp_with_codec(stamp: str, centroid_codec: str) -> str:
+    """Fold the forward centroid codec into an engine stamp (ISSUE
+    13): "q16" appends a "q" marker to the histogram component's wire
+    version ("h=tdigest/1" -> "h=tdigest/1q"), so a quantized-centroid
+    fleet and a lossless fleet read as DIFFERENT wire formats and
+    reject each other loudly before decode — quantized rows must never
+    be mistaken for (or silently mixed with) lossless ones. "lossless"
+    returns the stamp unchanged (legacy peers stay compatible)."""
+    if centroid_codec != "q16":
+        return stamp
+    return ",".join(part + "q" if part.startswith("h=") else part
+                    for part in stamp.split(","))
+
+
 # what an unstamped (legacy) peer is running, by definition
 DEFAULT_STAMP = engine_stamp(TDigestEngine(), HLLEngine())
 
 
 def parse_stamp(stamp: str) -> dict | None:
-    """"h=tdigest/1,s=hll/1" -> {"h": ("tdigest", 1), "s": ("hll", 1)};
-    None for a malformed stamp (the receiver then rejects — an
+    """"h=tdigest/1,s=hll/1" -> {"h": ("tdigest", 1, "lossless"),
+    "s": ("hll", 1, "lossless")}; a trailing "q" on a version (the
+    quantized-centroid marker, see stamp_with_codec) parses as codec
+    "q16". None for a malformed stamp (the receiver then rejects — an
     unparseable stamp is a peer we cannot reason about, which is the
     mismatch case, not the legacy case)."""
     out = {}
@@ -102,7 +118,10 @@ def parse_stamp(stamp: str) -> dict | None:
             eng, _, ver = rest.partition("/")
             if kind not in ("h", "s") or not eng:
                 return None
-            out[kind] = (eng, int(ver or 1))
+            codec = "lossless"
+            if ver.endswith("q"):
+                ver, codec = ver[:-1], "q16"
+            out[kind] = (eng, int(ver or 1), codec)
     except ValueError:
         return None
     return out if ("h" in out and "s" in out) else None
