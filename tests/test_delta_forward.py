@@ -725,3 +725,121 @@ def test_mixed_codec_fleet_refused_before_decode():
         if after > before:
             res.DEFAULT_REGISTRY.incr("import", "import.engine_mismatch",
                                       before - after)
+
+
+# ======================================================================
+# delta-aware proxy guard (ISSUE 14 satellite)
+# ======================================================================
+#
+# A proxy fanning ONE sender out to MULTIPLE globals re-shards the
+# per-sender seq chain: each receiver sees only its ring share's seqs,
+# every other seq reads as a gap, and the gap check refuses each delta
+# — a refusal/resync livelock. The proxy therefore DEMOTES the delta
+# marker to full on a multi-destination ring (the payload is a
+# full-fidelity touched-key subset; the marker only arms the gap
+# belt-check), warns once per sender, and counts
+# veneur.proxy.delta_demoted_total. Single-destination rings pass the
+# marker through untouched.
+
+
+class _RecordingFwd:
+    sent: list = []
+
+    def __init__(self, dest):
+        self.dest = dest
+
+    def send_metrics(self, metrics, envelope=None, **kw):
+        _RecordingFwd.sent.append((self.dest, envelope))
+
+
+def _proxy_with(dests):
+    from veneur_tpu.cluster.discovery import StaticDiscoverer
+    from veneur_tpu.cluster.proxy import ProxyServer
+    _RecordingFwd.sent = []
+    return ProxyServer(StaticDiscoverer(dests),
+                       forwarder_factory=_RecordingFwd)
+
+
+def _delta_list(n=40, sender="snd-dd"):
+    from veneur_tpu.cluster import wire
+    from veneur_tpu.cluster.protos import forward_pb2, metric_pb2
+    ms = [metric_pb2.Metric(name=f"dd.m{i}", type=metric_pb2.Counter,
+                            counter=metric_pb2.CounterValue(value=1))
+          for i in range(n)]
+    return forward_pb2.MetricList(
+        metrics=ms, envelope=wire.envelope_pb(sender, 7, 0, 1,
+                                              kind="delta"))
+
+
+def test_proxy_demotes_delta_on_multi_destination_ring(caplog):
+    import logging as _logging
+
+    from veneur_tpu.resilience import DEFAULT_REGISTRY
+    base = DEFAULT_REGISTRY.total("proxy", "proxy.delta_demoted")
+    proxy = _proxy_with(["g1:1", "g2:1"])
+    with caplog.at_level(_logging.WARNING,
+                         logger="veneur_tpu.cluster.proxy"):
+        assert proxy.handle_metric_list(_delta_list()) == []
+        assert proxy.handle_metric_list(_delta_list()) == []
+    assert len(_RecordingFwd.sent) >= 3   # both rounds fanned out
+    for _dest, env in _RecordingFwd.sent:
+        assert env is not None
+        assert env.forward_kind == 0      # demoted to full
+        assert env.sender_id == "snd-dd"  # rest of the envelope intact
+        assert env.interval_seq == 7
+    assert DEFAULT_REGISTRY.total(
+        "proxy", "proxy.delta_demoted") == base + 2
+    warned = [r for r in caplog.records if "demoted" in r.message]
+    assert len(warned) == 1               # once per sender, not per batch
+
+
+def test_proxy_passes_delta_through_on_single_destination():
+    from veneur_tpu.resilience import DEFAULT_REGISTRY
+    base = DEFAULT_REGISTRY.total("proxy", "proxy.delta_demoted")
+    proxy = _proxy_with(["only:1"])
+    assert proxy.handle_metric_list(_delta_list(sender="snd-one")) == []
+    assert len(_RecordingFwd.sent) == 1
+    _dest, env = _RecordingFwd.sent[0]
+    assert env.forward_kind == 1          # delta marker untouched
+    assert DEFAULT_REGISTRY.total(
+        "proxy", "proxy.delta_demoted") == base
+
+
+def test_http_proxy_front_demotes_delta_kind_header():
+    import json as _json
+
+    from veneur_tpu.cluster import wire
+    from veneur_tpu.cluster.proxy import HttpProxyFront
+
+    seen = []
+
+    class FakeDest:
+        def __init__(self, dest):
+            pass
+
+        def send_json(self, dicts, envelope=None):
+            seen.append(envelope)
+
+    proxy = _proxy_with(["h1:1", "h2:1"])
+    front = HttpProxyFront(proxy, dest_factory=FakeDest)
+    srv, port = front.start("127.0.0.1:0")
+    try:
+        headers = {"Content-Type": "application/json",
+                   "X-Veneur-Forward-Version": "jsonmetric-v1"}
+        headers.update(wire.envelope_headers("snd-h", 9, 0, 1,
+                                             kind="delta"))
+        assert wire.FORWARD_KIND_HEADER in headers
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/import",
+            data=_json.dumps([{"name": "m", "type": "counter",
+                               "tags": [], "value": 1}]).encode(),
+            headers=headers, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+        assert len(seen) == 1
+        env = seen[0]
+        # kind header dropped (absent == full); envelope ids intact
+        assert wire.forward_kind_from_headers(env) == wire.KIND_FULL
+        assert wire.envelope_from_headers(env) == ("snd-h", 9, 0, 1)
+    finally:
+        srv.shutdown()

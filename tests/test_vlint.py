@@ -283,3 +283,30 @@ def test_ds01_out_of_scope_modules_unchecked():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(repo, "veneur_tpu", "parallel", "engine.py")
     assert [v for v in run_paths([path]) if v.rule == "DS01"] == []
+
+
+def test_qt01_query_path_touches_live_engine():
+    # one finding per offense: the `with engine.lock:`, the explicit
+    # .lock.acquire(), the bank-attr write, and BOTH halves of the
+    # tuple bank write; the scratch-engine shape, the tier's own
+    # private lock (`self._lock`), and the suppressed block stay
+    # silent
+    assert lint("qt01_bad.py") == [("QT01", 10), ("QT01", 14),
+                                   ("QT01", 21), ("QT01", 24),
+                                   ("QT01", 24)]
+
+
+def test_qt01_history_module_is_clean():
+    # the invariant the check exists for: the shipping query tier
+    # never acquires an engine lock or writes a bank
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "durability", "history.py")
+    assert [v for v in run_paths([path]) if v.rule == "QT01"] == []
+
+
+def test_qt01_out_of_scope_modules_unchecked():
+    # the pipeline legitimately takes its own lock and writes its own
+    # banks — not QT01's business
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "veneur_tpu", "models", "pipeline.py")
+    assert [v for v in run_paths([path]) if v.rule == "QT01"] == []

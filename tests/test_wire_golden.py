@@ -554,3 +554,43 @@ class TestRandomizedRoundtrip:
             # serialize in unspecified order, so re-encoded bytes can
             # legally differ while the messages are identical
             assert back is not None and back == sp
+
+
+# ---- vectorized varint weight block (ISSUE 14 satellite) ----
+#
+# The q16 weight encoder's Python varint join was loop-bound at 100k
+# sketches; the numpy block must stay BYTE-IDENTICAL to the scalar
+# reference across the whole value range it can see (the encoder
+# refuses weights >= 2^63, so 9 varint bytes is the ceiling).
+
+def test_varint_block_bit_identical_to_scalar_reference():
+    from veneur_tpu.cluster.wire import _varint as scalar
+    from veneur_tpu.cluster.wire import _varint_block
+    edges = [0, 1, 127, 128, 255, 16383, 16384, 2**21 - 1, 2**21,
+             2**28 - 1, 2**28, 2**35, 2**49, 2**62, 2**63 - 1]
+    rng = np.random.default_rng(23)
+    vals = np.array(
+        edges + list(rng.integers(0, 2**63, 4096, dtype=np.uint64)),
+        np.uint64)
+    assert _varint_block(vals) == b"".join(
+        scalar(int(v)) for v in vals)
+    assert _varint_block(np.array([], np.uint64)) == b""
+    assert _varint_block(np.array([300], np.uint64)) == scalar(300)
+
+
+def test_q16_weight_bytes_unchanged_by_vectorization():
+    # the full-row regression: encode_q16_centroids output is pinned
+    # against a scalar-join re-encode of the same weights (the golden
+    # row tests above already pin the absolute bytes)
+    from veneur_tpu.cluster import wire
+    rng = np.random.default_rng(29)
+    means = rng.normal(50, 20, 300)
+    weights = np.round(rng.uniform(0.1, 9000, 300), 3)
+    row = wire.encode_q16_centroids(means, weights)
+    n, lo, hi = wire._Q16_HEAD.unpack_from(row, 0)
+    off = wire._Q16_HEAD.size + 2 * n
+    qw = np.maximum(1, np.rint(
+        np.asarray(weights, np.float64) * 8.0)).astype(np.uint64)
+    assert row[off:] == b"".join(wire._varint(int(w)) for w in qw)
+    got_m, got_w = wire.decode_q16_centroids(row)
+    np.testing.assert_allclose(got_w, weights, atol=1 / 16)

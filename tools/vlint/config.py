@@ -146,4 +146,13 @@ DEFAULT_CONFIG = {
     "wc01_allow": (
         "veneur_tpu/cluster/wire.py",
     ),
+    # QT01: read-path isolation for the time-travel query tier (path
+    # substring match; /qt01_ scopes the check's own fixture in) —
+    # query code must never acquire an engine ingest/flush lock or
+    # write live bank attributes; it works on scratch engines through
+    # their public restore/import/flush surface only.
+    "qt01_scope": (
+        "veneur_tpu/durability/history.py",
+        "/qt01_",
+    ),
 }

@@ -1,5 +1,5 @@
 """Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02, DR01,
-DR02, TL01, OV01, SK01, DS01.
+DR02, TL01, OV01, SK01, DS01, QT01.
 
 All checks are intentionally conservative: they resolve only what can
 be resolved statically within the project (local jit wrappers, module
@@ -1284,6 +1284,70 @@ def check_ds01(mod: PyModule, config: dict) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------------------- QT01
+
+_QT01_BANK_ATTRS = ("histo_bank", "counter_bank", "gauge_bank",
+                    "set_bank")
+
+
+def check_qt01(mod: PyModule, config: dict) -> list[Violation]:
+    """Read-path isolation for the time-travel query tier (ISSUE 14):
+    code under the query/read path (qt01_scope — durability/history.py
+    and the check's own fixture) must never acquire an engine's
+    ingest/flush lock (`with <x>.lock:`, `<x>.lock.acquire()`) or
+    write a bank attribute (`<x>.histo_bank = ...` and siblings). The
+    query tier works exclusively on SCRATCH engines minted by its
+    factory, through their public restore/import/flush surface — a
+    stray lock acquisition here could stall admit/flush behind a heavy
+    historical query (the estimate-outside-the-lock discipline
+    /debug/flush established), and a bank write could corrupt live
+    state a query must only read. Machine-checked so the isolation
+    stays an invariant, not review folklore."""
+    if not any(m in mod.path for m in config["qt01_scope"]):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx_expr = item.context_expr
+                if isinstance(ctx_expr, ast.Attribute) \
+                        and ctx_expr.attr == "lock":
+                    out.append(Violation(
+                        mod.path, node.lineno, "QT01",
+                        "query-path code acquires an engine lock "
+                        "(`with <x>.lock:`) — the read tier must never "
+                        "take the ingest/flush lock; go through the "
+                        "scratch engine's public surface or suppress "
+                        "with a reason naming the non-engine lock"))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "acquire" \
+                    and isinstance(f.value, ast.Attribute) \
+                    and f.value.attr == "lock":
+                out.append(Violation(
+                    mod.path, node.lineno, "QT01",
+                    "query-path code calls <x>.lock.acquire() — the "
+                    "read tier must never take the ingest/flush lock; "
+                    "suppress with a reason naming the non-engine "
+                    "lock"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                    else [t]
+                for e in elts:
+                    if isinstance(e, ast.Attribute) \
+                            and e.attr in _QT01_BANK_ATTRS:
+                        out.append(Violation(
+                            mod.path, node.lineno, "QT01",
+                            f"query-path code writes `<x>.{e.attr}` — "
+                            "the read tier must never write live "
+                            "banks; restore into a scratch engine via "
+                            "restore_checkpoint instead"))
+    return out
+
+
 # ------------------------------------------------------------------- driver
 
 def check_module(mod: PyModule, ctx: Context, config: dict
@@ -1304,4 +1368,5 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_ov01(mod, config))
     out.extend(check_sk01(mod, config))
     out.extend(check_ds01(mod, config))
+    out.extend(check_qt01(mod, config))
     return out

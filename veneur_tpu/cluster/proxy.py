@@ -76,6 +76,10 @@ class ProxyServer:
         self._stop = threading.Event()
         self._grpc_server = None
         self.http_front = None   # attached by the CLI when configured
+        # delta demotion warn-once set (ISSUE 14 satellite): senders
+        # already told their deltas are being demoted; bounded so a
+        # parade of one-shot sender ids can't grow it forever
+        self._delta_warned: set = set()
         self.refresh_destinations()
 
     # ---- ring maintenance ----
@@ -126,15 +130,77 @@ class ProxyServer:
                 groups.setdefault(self.ring.get(ring_key), []).append(m)
         return groups
 
+    # ---- delta demotion (ISSUE 14 satellite) ----
+    #
+    # Delta forwarding (ISSUE 13) assumes ONE receiver sees a sender's
+    # unbroken interval_seq chain. A proxy fanning one sender out to
+    # MULTIPLE globals re-shards that chain per metric: each receiver
+    # sees only the seqs whose ring share included it, every other seq
+    # is a gap, and the receiver-side gap check refuses each delta —
+    # the sender then spills + forces a full resync EVERY interval, a
+    # refusal/resync livelock that silently eats the delta win. The
+    # delta marker only ARMS that belt-check (a delta payload is a
+    # full-fidelity touched-key subset of its interval — applying it
+    # without the check can never corrupt state), so a multi-
+    # destination proxy DEMOTES the marker to full, warns once per
+    # sender that gap detection is disabled on this path, and counts
+    # veneur.proxy.delta_demoted_total. A single-destination ring
+    # keeps the chain contiguous and passes the marker through.
+
+    _MAX_DELTA_WARNED = 1024
+
+    def _note_delta_demotion(self, sender: str):
+        from ..resilience import DEFAULT_REGISTRY
+        DEFAULT_REGISTRY.incr("proxy", "proxy.delta_demoted")
+        with self._lock:
+            if sender in self._delta_warned:
+                return
+            if len(self._delta_warned) >= self._MAX_DELTA_WARNED:
+                self._delta_warned.clear()
+            self._delta_warned.add(sender)
+        log.warning(
+            "proxy: sender %r forwards DELTAS through a %d-destination "
+            "ring — the per-sender seq chain re-shards, so deltas are "
+            "demoted to full sends here (receiver gap detection is "
+            "disabled on this path; run delta fleets with a single "
+            "destination, or set forward_delta: false at the sender)",
+            sender, len(self.ring))
+
+    def _demote_delta_pb(self, envelope):
+        """forwardrpc arm: clear Envelope.forward_kind (0 == full) on
+        a COPY — the inbound request object is not ours to mutate."""
+        if envelope is None or envelope.forward_kind != 1 \
+                or len(self.ring) <= 1:
+            return envelope
+        self._note_delta_demotion(envelope.sender_id or "(unknown)")
+        demoted = forward_pb2.Envelope()
+        demoted.CopyFrom(envelope)
+        demoted.forward_kind = 0
+        return demoted
+
+    def demote_delta_headers(self, env: dict | None) -> dict | None:
+        """jsonmetric-v1 arm: drop the kind header (absent == full)."""
+        if not env or len(self.ring) <= 1:
+            return env
+        if wire.forward_kind_from_headers(env) != wire.KIND_DELTA:
+            return env
+        self._note_delta_demotion(
+            env.get(wire.ENVELOPE_SENDER_HEADER, "(unknown)"))
+        return {k: v for k, v in env.items()
+                if k != wire.FORWARD_KIND_HEADER}
+
     def handle_metric_list(self, metric_list):
         """The SendMetrics implementation: fan out groups concurrently
         (one goroutine per destination in the reference). An incoming
         idempotency envelope is passed through UNMODIFIED to every
-        destination's share: the ring split is deterministic, so a
-        sender replay re-splits identically and each global dedupes
-        its own share on the original (sender, seq, chunk) ids."""
+        destination's share — except a delta kind marker on a multi-
+        destination ring, which demotes to full (see above): the ring
+        split is deterministic, so a sender replay re-splits
+        identically and each global dedupes its own share on the
+        original (sender, seq, chunk) ids."""
         envelope = (metric_list.envelope
                     if metric_list.HasField("envelope") else None)
+        envelope = self._demote_delta_pb(envelope)
         # sketch-engine stamp + advisory prefix sketches pass through
         # verbatim to EVERY destination's share (stripping the stamp
         # would make a non-default fleet read as legacy and be refused
@@ -381,6 +447,10 @@ class HttpProxyFront:
                     wire.SKETCH_HEADER,
                     wire.PREFIX_SKETCH_HEADER)
                     if self.headers.get(h) is not None}
+                # delta demotion on the HTTP arm too: a multi-
+                # destination ring re-shards the seq chain (see
+                # ProxyServer._note_delta_demotion)
+                env = front.proxy.demote_delta_headers(env)
                 errs = front.handle_batch(dicts, envelope=env or None)
                 self.send_response(502 if errs else 200)
                 self.end_headers()

@@ -168,6 +168,29 @@ class Config:
     # only the dirty rows are serialized either way. (0, 1].
     durability_engine_delta_threshold: float = 0.5
 
+    # --- time-travel query tier (durability/history.py, ISSUE 14) ---
+    # Retain a window of committed checkpoint generations (one per
+    # closed flush interval: the boundary's checkpoint groups + the
+    # interval's write-ahead import ops, sealed atomically and indexed
+    # by interval-close wall time) and serve GET /query?metric=&q=&
+    # t0=&t1= from them: historical percentiles, counts, and
+    # cardinalities reconstructed into SCRATCH engines and merged
+    # across intervals through the engine contract. 0 (the default)
+    # = off: no history files, no query endpoint, zero write-path
+    # cost. Requires durability_enabled + an engine-checkpointing
+    # import tier (the same arming rule as durability_engine_snapshot;
+    # mesh/native excluded). README "Time-travel queries".
+    history_retention_generations: int = 0
+    # additionally drop generations older than this relative to the
+    # NEWEST retained close stamp ("0s" = count bound only)
+    history_retention_seconds: str = "0s"
+    # queries run on a dedicated executor (never the ingest/flush
+    # path): its width, the bounded result cache (keyed on metric +
+    # window + generation range), and the per-query wall timeout
+    query_max_concurrent: int = 1
+    query_cache_entries: int = 64
+    query_timeout: str = "30s"
+
     # --- overload defense (veneur_tpu/ingest/admission.py) ---
     # Off by default: with the defense disabled the ingest path does
     # zero admission work and behavior is identical to the pre-defense
@@ -441,6 +464,26 @@ def _validate(cfg: Config) -> None:
             "is the dirty fraction above which a checkpoint switches "
             "from row gather to whole-leaf fetch, got "
             f"{cfg.durability_engine_delta_threshold!r}")
+    if cfg.history_retention_generations < 0:
+        raise ValueError(
+            "history_retention_generations must be >= 0 (0 = "
+            "time-travel tier off)")
+    if cfg.history_retention_generations > 0 and \
+            not cfg.durability_enabled:
+        raise ValueError(
+            "history_retention_generations requires "
+            "durability_enabled (the time-travel tier reads the "
+            "engine checkpoint journal)")
+    if _parse_interval(cfg.history_retention_seconds) < 0:
+        raise ValueError(
+            "history_retention_seconds must be >= 0 (0 = no age "
+            "bound)")
+    if cfg.query_max_concurrent < 1:
+        raise ValueError("query_max_concurrent must be >= 1")
+    if cfg.query_cache_entries < 0:
+        raise ValueError("query_cache_entries must be >= 0")
+    if _parse_interval(cfg.query_timeout) <= 0:
+        raise ValueError("query_timeout must be a positive duration")
     for key in ("flush_timeout", "retry_backoff_base",
                 "retry_backoff_cap", "retry_deadline",
                 "breaker_open_duration", "forward_dedupe_ttl",

@@ -37,10 +37,19 @@ already Combined before the crash.
 All on-disk writes in this package go through the `Journal` append /
 snapshot API — vlint DR01 machine-checks that no other module under
 `durability/` opens files for writing.
+
+The `history` module (ISSUE 14) grows a READ tier on top: a retained
+window of committed checkpoint generations (one per closed flush
+interval, manifest-indexed by interval-close wall time) and the
+time-travel query engine behind `GET /query` — historical percentiles,
+counts, and cardinalities reconstructed through the same recovery-
+restore path, into scratch engines, never the live banks.
 """
 
+from .history import HistoryStore, QueryError, QueryTier
 from .journal import Journal, crc32c
 from .state import EngineJournal, ForwardJournal, WatermarkJournal
 
 __all__ = ["Journal", "crc32c", "EngineJournal", "ForwardJournal",
-           "WatermarkJournal"]
+           "WatermarkJournal", "HistoryStore", "QueryTier",
+           "QueryError"]

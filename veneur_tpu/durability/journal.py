@@ -215,6 +215,55 @@ def decode_frames(data: bytes, offset: int = 0):
         offset = start + length
 
 
+def read_framed_file(path: str, magic: bytes):
+    """Read one framed file (journal/snapshot/history segment/manifest):
+    (records, generation, good_end_offset, truncated). A missing,
+    short, or wrong-magic file reads as empty with generation -1
+    (unknown); a torn tail stops the scan at the last good frame.
+    Never raises on corruption — the shared recovery contract."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return [], -1, 0, False
+    header = len(magic) + _GEN.size
+    if len(data) < header or data[:len(magic)] != magic:
+        # not ours / torn before the header finished: treat as empty,
+        # flag it if there were bytes to lose
+        return [], -1, 0, bool(data)
+    (gen,) = _GEN.unpack_from(data, len(magic))
+    records, end, truncated = decode_frames(data, header)
+    return records, gen, end, truncated
+
+
+def write_framed_file(path: str, magic: bytes, generation: int,
+                      records, fsync: bool = True) -> int:
+    """Atomically (re)write one framed file: write-temp, fsync,
+    os.replace, fsync the directory — the same publish discipline as
+    Journal.snapshot, shared by the history tier's segment and
+    manifest writes (durability/history.py), which is why the raw file
+    I/O lives HERE (vlint DR01: journal.py owns the framing/fsync/
+    atomic-rename contract). A crash at any point leaves either the
+    old file or the new one, never a torn mix. Returns bytes written."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(magic + _GEN.pack(int(generation)))
+        for rec_type, payload in records:
+            f.write(encode_frame(rec_type, payload))
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+        nbytes = f.tell()
+    os.replace(tmp, path)
+    if fsync:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    return nbytes
+
+
 class Journal:
     """One named journal + snapshot pair inside a durability directory.
 
@@ -284,19 +333,7 @@ class Journal:
         """(records, generation, good_length, truncated) for one framed
         file; a missing/short/wrong-magic file reads as empty with
         generation -1 (unknown)."""
-        try:
-            with open(path, "rb") as f:
-                data = f.read()
-        except OSError:
-            return [], -1, 0, False
-        header = len(magic) + _GEN.size
-        if len(data) < header or data[:len(magic)] != magic:
-            # not ours / torn before the header finished: treat as
-            # empty, count it if there were bytes to lose
-            return [], -1, 0, bool(data)
-        (gen,) = _GEN.unpack_from(data, len(magic))
-        records, end, truncated = decode_frames(data, header)
-        return records, gen, end, truncated
+        return read_framed_file(path, magic)
 
     def load(self):
         """Recover: returns (snapshot_records or None, journal_records).

@@ -101,6 +101,24 @@ REC_ENGINE_KEYS = 12
 REC_ENGINE_BANK = 13
 REC_ENGINE_STAGED = 14
 REC_ENGINE_COMMIT = 15
+# time-travel history tier (ISSUE 14, durability/history.py):
+#   HISTORY_META   first record of a history SEGMENT file — one closed
+#                  flush interval's identity: generation id, the
+#                  interval-close wall time, the previous boundary's
+#                  close time (the interval's open edge), the
+#                  per-engine RETIRE watermarks (the op ids the flush
+#                  swap actually carried — the exact per-engine upper
+#                  replay cut for this interval), and the op-id range
+#                  the segment retains. The rest of the segment is the
+#                  previous boundary's checkpoint groups (REC_ENGINE_*
+#                  records, reused verbatim — the interval's baseline)
+#                  followed by the interval's write-ahead import ops.
+#   HISTORY_INDEX  one manifest row per COMMITTED generation: id,
+#                  close/open times, segment byte size. The manifest
+#                  is rewritten atomically; a generation absent from
+#                  it is not committed, whatever files exist.
+REC_HISTORY_META = 16
+REC_HISTORY_INDEX = 17
 
 # engine bank kinds (the order pipeline.AggregationEngine owns them in)
 BANK_HISTO = 0
@@ -592,6 +610,53 @@ def decode_engine_staged(data: bytes):
             off += _F64.size
             staged[field].append((slot, value))
     return engine_idx, staged
+
+
+# ------------------------------------------- history tier (ISSUE 14)
+
+_HIST_META = struct.Struct("<QQQI")   # gen, close_ns, prev_close_ns,
+#                                       n_engines
+_HIST_IDX = struct.Struct("<QQQQ")    # gen, close_ns, prev_close_ns,
+#                                       segment bytes
+
+
+def encode_history_meta(gen: int, close_ns: int, prev_close_ns: int,
+                        retire_wms, op_lo: int, op_hi: int) -> bytes:
+    """One history segment's identity record (see the kind table)."""
+    retire_wms = [int(w) for w in retire_wms]
+    out = [_HIST_META.pack(int(gen), int(close_ns), int(prev_close_ns),
+                           len(retire_wms))]
+    out.extend(_U64.pack(w) for w in retire_wms)
+    out.append(_U64.pack(int(op_lo)))
+    out.append(_U64.pack(int(op_hi)))
+    return b"".join(out)
+
+
+def decode_history_meta(data: bytes):
+    """-> (gen, close_ns, prev_close_ns, [retire_wm per engine],
+    op_lo, op_hi)."""
+    gen, close_ns, prev_close_ns, n = _HIST_META.unpack_from(data, 0)
+    off = _HIST_META.size
+    wms = []
+    for _ in range(n):
+        (w,) = _U64.unpack_from(data, off)
+        off += _U64.size
+        wms.append(w)
+    (op_lo,) = _U64.unpack_from(data, off)
+    off += _U64.size
+    (op_hi,) = _U64.unpack_from(data, off)
+    return gen, close_ns, prev_close_ns, wms, op_lo, op_hi
+
+
+def encode_history_index(gen: int, close_ns: int, prev_close_ns: int,
+                         nbytes: int) -> bytes:
+    return _HIST_IDX.pack(int(gen), int(close_ns), int(prev_close_ns),
+                          int(nbytes))
+
+
+def decode_history_index(data: bytes):
+    """-> (gen, close_ns, prev_close_ns, nbytes)."""
+    return _HIST_IDX.unpack_from(data, 0)
 
 
 def encode_engine_checkpoint(engine_idx: int, n_engines: int,

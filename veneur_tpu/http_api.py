@@ -83,7 +83,7 @@ class HttpApi:
                  ledger=None, debug_state=None, profile=None,
                  observer=None, fleet_state=None, health=None,
                  submit_batch=None, engine_stamp=None, note_stamp=None,
-                 merge_sketches=None):
+                 merge_sketches=None, query=None):
         """`debug_state()` (optional) returns the JSON-ready dict for
         GET /debug/flush; `profile(ticks)` (optional) schedules an
         on-demand jax.profiler capture — absent means the knob is off
@@ -100,6 +100,15 @@ class HttpApi:
         flusher is detectable from OUTSIDE the process, not only by
         absence of data. Without `health`, /healthz degrades to the
         legacy boolean `healthy` callback.
+
+        `query` (optional, ISSUE 14): the time-travel query tier —
+        GET /query?metric=&q=&t0=&t1= serves historical percentiles /
+        counts / cardinalities reconstructed from the durability
+        journal's retained checkpoint generations. Absent means the
+        tier is not armed on this server (history retention off, or
+        not an import tier) and the endpoint answers 404. The callback
+        runs the query on the tier's OWN executor — never this handler
+        thread beyond the wait, never the ingest/flush path.
 
         `submit_batch` (optional, `submit_batch([(digest, pb), ...])`)
         routes one request's decoded metrics as a unit — the Server's
@@ -128,6 +137,7 @@ class HttpApi:
         self._engine_stamp = engine_stamp
         self._note_stamp = note_stamp
         self._merge_sketches = merge_sketches
+        self._query = query
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -173,8 +183,37 @@ class HttpApi:
                     self._reply(200, "\n".join(out).encode())
                 elif self.path.startswith("/debug/flush"):
                     self._debug_flush()
+                elif urlparse(self.path).path.rstrip("/") == "/query":
+                    self._serve_query()
                 else:
                     self._reply(404, b"not found\n")
+
+            def _serve_query(self):
+                """GET /query (ISSUE 14): time-travel reads from the
+                durability journal's retained generations. Schema in
+                README 'Time-travel queries'."""
+                if api._query is None:
+                    self._reply(404, b"no time-travel query tier on "
+                                     b"this server (set "
+                                     b"history_retention_generations "
+                                     b"with durability enabled)\n")
+                    return
+                # keep_blank_values: `tags=` (empty) means "untagged
+                # keys only", distinct from no tags filter at all
+                qs = parse_qs(urlparse(self.path).query,
+                              keep_blank_values=True)
+                params = {k: v[0] for k, v in qs.items() if v}
+                try:
+                    body = api._query(params)
+                except Exception as e:
+                    status = getattr(e, "status", 500)
+                    detail = getattr(e, "detail", f"query failed: {e}")
+                    self._reply(status, json.dumps(
+                        {"error": detail}).encode(),
+                        "application/json")
+                    return
+                self._reply(200, json.dumps(
+                    body, default=str).encode(), "application/json")
 
             def _health_verdict(self, readiness: bool):
                 """GET /healthz | /ready: structured verdicts, 503 on

@@ -1922,6 +1922,12 @@ class AggregationEngine:
                 self._gauge_seq = 0
                 snap = self._swap_banks()
                 dirty = self._retire_dirty()
+                # the applied-op watermark AT THE SWAP: per-queue
+                # application is FIFO, so every op <= this id is in the
+                # retiring snapshot and every later one in the shadow
+                # banks — the per-interval replay cut the time-travel
+                # history tier records (ISSUE 14)
+                retired_wm = self.last_import_op
                 (active, status, stats_samples, dropped,
                  histo_key_count) = self._flush_bookkeeping(full_export)
             t_swap = time.monotonic_ns()
@@ -1942,6 +1948,7 @@ class AggregationEngine:
                 snap = self._swap_banks()
                 dirty = self._retire_dirty()
                 self._gauge_seq = 0
+                retired_wm = self.last_import_op
                 (active, status, stats_samples, dropped,
                  histo_key_count) = self._flush_bookkeeping(full_export)
             t_swap = time.monotonic_ns()
@@ -2168,6 +2175,9 @@ class AggregationEngine:
             # degrade to full when no bitmap exists — mesh, tracking
             # off — or the engine does not forward)
             "forward_kind": export.kind,
+            # the swap-time applied-op watermark (the history tier's
+            # per-interval replay cut, ISSUE 14)
+            "retired_import_op": retired_wm,
         }
         return FlushResult(frame=frame, export=export, stats=stats,
                            status_metrics=status_metrics)

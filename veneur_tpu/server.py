@@ -327,11 +327,21 @@ class Server:
         self._recovering = False         # True until start() completes
         self._next_import_op = 0
         self._recent_import_ops: list = []   # (op_id, bytes), 2-tick window
+        self._import_ops_evicted = False     # cap evicted since last seal
         self._ops_at_last_checkpoint = 0
         self._last_checkpoint_sig = None
         self._last_checkpoint_t = None
         self._last_checkpoint_stats = (0, 0)   # (dirty, total) piles
         self._import_submit_lock = threading.Lock()
+        # Time-travel query tier (durability/history.py, ISSUE 14):
+        # retained window of committed checkpoint generations + the
+        # GET /query read path. Armed below, with the engine journal.
+        self._history = None
+        self._query_tier = None
+        self._history_baseline = None      # (recs, marks, empty) of
+        #                                    the prev boundary — the
+        #                                    next generation's baseline
+        self._history_prev_close_ns = 0
         # Arming keys on the IMPORT tiers (a gRPC import listener or a
         # declared global), NOT on http_address alone: http_address is
         # also just the ops/healthcheck listener on sending-tier
@@ -446,6 +456,12 @@ class Server:
             self.flight = observe.FlightRecorder(
                 capacity=cfg.flight_recorder_ticks,
                 max_phases=cfg.flight_recorder_max_phases)
+        # Time-travel query tier (ISSUE 14): armed with the engine
+        # journal (recovery already ran above), built HERE because its
+        # query ticks adopt into the flight ring just created
+        if self._engine_journal_armed \
+                and cfg.history_retention_generations > 0:
+            self._setup_history()
         # on-demand jax.profiler capture around flush ticks (see
         # _maybe_profile); written under _stats_lock
         self._profile_ticks = 0
@@ -850,6 +866,14 @@ class Server:
                     j.close()
                 except Exception:
                     log.exception("durability journal close failed")
+        if self._query_tier is not None:
+            # the history store itself holds no file handles (segments
+            # and the manifest publish atomically per boundary); only
+            # the query executor needs shutting down
+            try:
+                self._query_tier.close()
+            except Exception:
+                pass
         if self.trace_client is not None:
             try:
                 self.trace_client.close()
@@ -1235,6 +1259,12 @@ class Server:
                     if len(self._recent_import_ops) > \
                             self.MAX_RETAINED_IMPORT_OPS:
                         self._recent_import_ops.pop(0)
+                        # the history tier seals generations from this
+                        # list; an eviction means the next generation
+                        # would silently under-count — flag it so the
+                        # seal degrades LOUDLY (crash recovery is
+                        # unaffected: it reads the full journal)
+                        self._import_ops_evicted = True
                 except Exception:
                     self._engine_journal_failed("import write-ahead")
             groups: dict[int, list] = {}
@@ -1262,67 +1292,44 @@ class Server:
         than scattering rows into wrong slots."""
         from .cluster import wire
         from .durability import records as drecords
+        from .durability.history import collect_checkpoint_groups
         from .utils.hashing import metric_digest
         tel, S = self.telemetry, observe.SERVER_SCOPE
         t0 = time.monotonic_ns()
         recs = self._engine_journal.load_records()
-        latest: dict[int, dict] = {}    # committed groups only
-        pending: dict[int, dict] = {}   # groups awaiting their COMMIT
+        # ONE committed-group walk (durability/history.py owns it —
+        # the time-travel tier reconstructs generations through the
+        # SAME state machine, so the COMMIT discipline cannot drift
+        # between what recovery restores and what queries serve):
+        # a group counts only once its COMMIT arrived — a crash
+        # mid-append leaves META (whose watermark would suppress op
+        # replay) without the KEYS/BANK rows that back it, and
+        # restoring that would be silent data loss. BANK payloads come
+        # back ENCODED (their leaf order is engine-aware) and decode
+        # below against the engines this server runs — a journal
+        # written by DIFFERENT backends is refused at the fingerprint
+        # check before any decoded rows can land.
+        latest, op_payloads, torn, errors = \
+            collect_checkpoint_groups(recs)
         ops: list = []
-        for rec_type, payload in recs:
+        for payload in op_payloads:
             try:
-                if rec_type == drecords.REC_ENGINE_IMPORT:
-                    ops.append(drecords.decode_engine_import(payload))
-                    continue
-                elif rec_type == drecords.REC_ENGINE_META:
-                    idx, n_eng, wm, gseq, fpr = \
-                        drecords.decode_engine_meta(payload)
-                    pending[idx] = {"meta": (n_eng, wm, gseq, fpr),
-                                    "keys": {}, "banks": {},
-                                    "staged": {}}
-                elif rec_type == drecords.REC_ENGINE_KEYS:
-                    idx, kind, interval, entries = \
-                        drecords.decode_engine_keys(payload)
-                    if idx in pending:
-                        pending[idx]["keys"][kind] = (interval, entries)
-                elif rec_type == drecords.REC_ENGINE_BANK:
-                    # leaf order is engine-aware: decode with the
-                    # engines this server runs (a journal written by
-                    # DIFFERENT backends is refused at the fingerprint
-                    # check before any decoded rows can land)
-                    idx, kind, ids, leaves = \
-                        drecords.decode_engine_bank(
-                            payload,
-                            leaf_names_of=self.engines[0]
-                            .bank_leaf_names)
-                    if idx in pending:
-                        pending[idx]["banks"][kind] = (ids, leaves)
-                elif rec_type == drecords.REC_ENGINE_STAGED:
-                    idx, staged = drecords.decode_engine_staged(payload)
-                    if idx in pending:
-                        pending[idx]["staged"] = staged
-                elif rec_type == drecords.REC_ENGINE_COMMIT:
-                    # only a COMMITTED group supersedes the previous
-                    # one: a crash mid-append leaves META (whose
-                    # watermark would suppress op replay) without the
-                    # KEYS/BANK rows that back it — restoring that
-                    # would be silent data loss
-                    idx = drecords.decode_engine_commit(payload)
-                    if idx in pending:
-                        latest[idx] = pending.pop(idx)
-                # foreign kinds (another journal's records) are skipped
+                ops.append(drecords.decode_engine_import(payload))
             except Exception:
-                tel.incr(S, "durability.engine_recovery_errors")
-                log.exception("engine recovery: undecodable record "
-                              "(type %d) skipped", rec_type)
-        if pending:
-            tel.incr(S, "durability.engine_recovery_errors",
-                     len(pending))
+                errors += 1
+                log.exception(
+                    "engine recovery: undecodable import op skipped")
+        if errors:
+            tel.incr(S, "durability.engine_recovery_errors", errors)
+            log.warning("engine recovery: %d undecodable record(s) "
+                        "skipped", errors)
+        if torn:
+            tel.incr(S, "durability.engine_recovery_errors", torn)
             log.warning(
                 "engine recovery: %d torn (uncommitted) checkpoint "
                 "group(s) dropped — falling back to the previous "
                 "complete group(s); ops above their watermark replay",
-                len(pending))
+                torn)
         n = len(self.engines)
         for idx, g in latest.items():
             n_eng = g["meta"][0]
@@ -1340,10 +1347,21 @@ class Server:
         try:
             for idx, g in latest.items():
                 _n_eng, wm, gseq, fpr = g["meta"]
+                banks: dict = {}
+                for payload in g["banks"]:
+                    _i, kind, ids, leaves = \
+                        drecords.decode_engine_bank(
+                            payload,
+                            leaf_names_of=self.engines[idx]
+                            .bank_leaf_names)
+                    banks[kind] = (ids, leaves)
                 self.engines[idx].restore_checkpoint(
-                    fpr, gseq, wm, g["keys"], g["banks"], g["staged"])
+                    fpr, gseq, wm, g["keys"], banks, g["staged"])
                 restored += 1
-        except ValueError as e:
+        except Exception as e:
+            # fingerprint mismatch (ValueError) or an undecodable bank
+            # row: refuse the WHOLE recovery loudly — a partial
+            # restore would flush silently-wrong state
             log.error("engine recovery REFUSED: %s — starting fresh", e)
             tel.incr(S, "durability.engine_recovery_errors")
             self._recovery = {"refused": str(e)}
@@ -1416,7 +1434,8 @@ class Server:
                      "in %.1fms", restored, replayed, metrics_replayed,
                      restore_ns / 1e6)
 
-    def _engine_checkpoint(self):
+    def _engine_checkpoint(self, ts: int | None = None,
+                           retired_wms: list | None = None):
         """The flush-boundary hook: append one self-contained delta
         checkpoint group per engine (dirty piles only — the swap
         re-zeroed everything else), skip entirely when nothing changed
@@ -1425,12 +1444,18 @@ class Server:
         groups plus the ops the two-checkpoint retention window still
         holds (an op admitted longer ago has had a full interval to
         drain into an engine and be covered by a watermark; the same
-        one-interval fuzz the watermark journal documents)."""
+        one-interval fuzz the watermark journal documents).
+
+        With the history tier armed (ISSUE 14), the boundary ALSO
+        seals the closing interval as a query generation: `ts` is the
+        interval-close wall time and `retired_wms` the per-engine
+        swap-time watermarks the flush results reported — the
+        interval's exact per-engine replay cut."""
         from .durability import records as drecords
         tel, S = self.telemetry, observe.SERVER_SCOPE
         recs: list = []
         dirty = total = 0
-        staged_any = False
+        staged_any = interned_any = False
         marks = []
         n = len(self.engines)
         for i, eng in enumerate(self.engines):
@@ -1441,7 +1466,18 @@ class Server:
             staged_any = staged_any or any(
                 snap["staged"][f] for f in ("centroids", "sets",
                                             "counters", "gauges"))
+            interned_any = interned_any or any(
+                entries for _iv, entries in snap["interner"].values())
             marks.append(snap["last_import_op"])
+        # a baseline with no bank rows, nothing staged, and no interned
+        # keys reconstructs to NOTHING — the next interval can seal as
+        # a zero-cost empty generation if it also gets no ops (the
+        # history tier's idle path; interner idle-TTL eviction makes a
+        # quiet server converge here)
+        empty_next = not dirty and not staged_any and not interned_any
+        if self._history is not None and ts is not None:
+            self._history_seal(ts, retired_wms or [0] * n, recs, marks,
+                               empty_next)
         sig = (tuple(marks),
                tuple(len(ki) for eng in self.engines
                      for _k, _a, ki in eng._bank_table()))
@@ -1479,6 +1515,166 @@ class Server:
             # appended between the retention snapshot and the journal
             # truncate would be lost from both
             self._engine_journal.maybe_compact(recs + retained)
+
+    # ---------- time-travel history + query tier (ISSUE 14) ----------
+
+    def _setup_history(self):
+        """Arm the retention store + query tier (called from __init__,
+        inside the engine-journal-armed branch, AFTER recovery): the
+        post-recovery consistent cut becomes the FIRST generation's
+        baseline, and the query tier gets a factory minting SCRATCH
+        engines from a copy of the live engine shape — it never holds
+        a reference to the live pipeline (read-path isolation, vlint
+        QT01)."""
+        import dataclasses
+
+        from .durability import HistoryStore, QueryTier
+        cfg = self.cfg
+        self._history = HistoryStore(
+            cfg.durability_dir,
+            retention_generations=cfg.history_retention_generations,
+            retention_seconds=_parse_interval(
+                cfg.history_retention_seconds),
+            fsync=cfg.durability_fsync != "never",
+            registry=self.telemetry)
+        self._history_baseline = self._capture_history_baseline()
+        # the next generation's open edge: the newest RETAINED close
+        # stamp (a restart continues the timeline where it left off —
+        # the first post-restart interval absorbs the crash window),
+        # else 0 — NOT wall-now, because flush timestamps may be
+        # scripted (tests, replay rigs) and an epoch open edge would
+        # postdate the first scripted close; a fresh store's first
+        # generation simply claims everything before its close
+        retained = self._history.entries()
+        self._history_prev_close_ns = (retained[-1].close_ns
+                                       if retained else 0)
+        ecfg = self.engines[0].cfg
+
+        def scratch_factory(percentiles=None, aggregates=None,
+                            merge=False):
+            # merge=False: a per-generation reconstruction engine —
+            # forward-enabled so its flush builds the export rows the
+            # merge stage consumes. merge=True: the cross-interval
+            # merge engine — global-tier presentation so its frame
+            # carries percentiles (the requested quantiles) for every
+            # live key. Neither flag is part of the checkpoint
+            # fingerprint, so restores match the live shape exactly.
+            kw = dict(forward_enabled=not merge, is_global=merge)
+            if percentiles is not None:
+                kw["percentiles"] = tuple(percentiles)
+            if aggregates is not None:
+                kw["aggregates"] = tuple(aggregates)
+            return AggregationEngine(dataclasses.replace(ecfg, **kw))
+
+        self._query_tier = QueryTier(
+            self._history, scratch_factory, len(self.engines),
+            flight=self.flight, registry=self.telemetry,
+            scope=observe.SERVER_SCOPE,
+            engines_describe=self.engines[0].engines_describe(),
+            max_concurrent=cfg.query_max_concurrent,
+            cache_entries=cfg.query_cache_entries,
+            timeout_s=_parse_interval(cfg.query_timeout))
+
+    def _capture_history_baseline(self):
+        """(records, per-engine watermarks, provably-empty flag) of a
+        consistent cut across every engine — the baseline the NEXT
+        closed interval reconstructs on top of."""
+        from .durability import records as drecords
+        recs: list = []
+        marks: list = []
+        empty = True
+        n = len(self.engines)
+        for i, eng in enumerate(self.engines):
+            snap = eng.checkpoint_state()
+            recs.extend(drecords.encode_engine_checkpoint(i, n, snap))
+            marks.append(snap["last_import_op"])
+            if snap["piles_dirty"] or any(
+                    snap["staged"][f] for f in ("centroids", "sets",
+                                                "counters", "gauges")) \
+                    or any(entries for _iv, entries
+                           in snap["interner"].values()):
+                empty = False
+        return recs, marks, empty
+
+    def _history_seal(self, ts: int, retired_wms: list, recs: list,
+                      marks: list, empty_next: bool = False):
+        """Seal the interval that just flushed as one query
+        generation: its baseline is the PREVIOUS boundary's checkpoint
+        groups, its ops everything write-aheaded above the baseline's
+        lowest watermark (the per-engine exact cut — baseline wm <
+        op_id <= retire wm — is applied at query time, exactly like
+        recovery's replay filter), its close stamp the flush's wall
+        timestamp (scripted clocks stay scripted end to end). Runs on
+        the flusher thread; a failing disk degrades history loudly
+        without failing the tick (the journal-error policy)."""
+        tel, S = self.telemetry, observe.SERVER_SCOPE
+        try:
+            base_recs, base_marks, base_empty = self._history_baseline
+            min_wm = min(base_marks) if base_marks else 0
+            with self._import_submit_lock:
+                op_recs = [(i, p) for i, p in self._recent_import_ops
+                           if i > min_wm]
+                evicted, self._import_ops_evicted = \
+                    self._import_ops_evicted, False
+            if evicted:
+                # the in-memory retention cap dropped ops this
+                # interval: the generation seals INCOMPLETE. Loud +
+                # counted — a silent under-count would violate the
+                # tier's exactness contract (crash recovery still has
+                # the full journal; only history is lossy here)
+                tel.incr(S, "durability.history_truncated")
+                log.warning(
+                    "history: MAX_RETAINED_IMPORT_OPS (%d) evicted "
+                    "import ops this interval — the sealed generation "
+                    "under-counts; raise the cap or shorten the flush "
+                    "interval", self.MAX_RETAINED_IMPORT_OPS)
+            close_ns = int(ts) * 1_000_000_000
+            if base_empty and not op_recs:
+                # provably-empty interval: a manifest row, not a
+                # segment (consecutive ones coalesce — an idle tier
+                # must not write a segment + fsyncs per tick)
+                self._history.append_empty(
+                    close_ns, self._history_prev_close_ns)
+            else:
+                self._history.append(close_ns,
+                                     self._history_prev_close_ns,
+                                     retired_wms, base_recs, op_recs)
+            # vlint: disable=TH01 reason=flush-path-only state; flushes
+            # are serialized (one flusher thread, tests call flush_once
+            # synchronously)
+            self._history_baseline = (recs, marks, empty_next)
+            # vlint: disable=TH01 reason=flush-path-only state (above)
+            self._history_prev_close_ns = close_ns
+            hs = self._history.debug_state()
+            tel.set_gauge(S, "history.generations", hs["generations"])
+            tel.set_gauge(S, "history.bytes", hs["bytes"])
+        except Exception:
+            tel.incr(S, "durability.journal_errors")
+            log.exception(
+                "history generation seal failed; DISABLING the "
+                "time-travel tier for this process (aggregation and "
+                "crash recovery unaffected)")
+            # vlint: disable=TH01 reason=monotone one-way degrade on
+            # the flusher thread; readers (query path, debug page)
+            # tolerate either value across the flip
+            self._history = None
+            if self._query_tier is not None:
+                self._query_tier.close()
+                # vlint: disable=TH01 reason=same one-way degrade; the
+                # http wiring null-checks per request
+                self._query_tier = None
+
+    def _serve_query(self, params: dict) -> dict:
+        """GET /query backend (http_api wires it when the tier is
+        armed): runs on the query tier's dedicated executor, never on
+        the ingest/flush path."""
+        from .durability import QueryError
+        tier = self._query_tier
+        if tier is None:    # disk-error degrade after the listener bound
+            raise QueryError(
+                503, "time-travel tier disabled after a disk error "
+                     "(see veneur.durability.journal_errors_total)")
+        return tier.query(params)
 
     def _start_import_listener(self, addr: str):
         """Global-mode gRPC receive path (importsrv): forwarded metrics
@@ -1531,6 +1727,10 @@ class Server:
             engine_stamp=self.engine_stamp,
             note_stamp=self._note_sketch_stamp,
             merge_sketches=self.merge_prefix_sketches,
+            # time-travel query tier (ISSUE 14): absent = 404, so an
+            # operator can tell "not armed" from "bad query"
+            query=(self._serve_query
+                   if self._query_tier is not None else None),
             # the profiler trigger only exists when the operator opted
             # in via debug_flush_profile (a capture is a debug action)
             profile=(self.request_profile_capture
@@ -1981,8 +2181,12 @@ class Server:
                     # engine delta checkpoint: the banks were just
                     # swapped, so `fresh + dirty rows` is the whole
                     # post-flush state; everything admitted since rides
-                    # the write-ahead import ops
-                    self._engine_checkpoint()
+                    # the write-ahead import ops. The per-engine
+                    # swap-time watermarks seal the closed interval as
+                    # a time-travel generation (ISSUE 14).
+                    self._engine_checkpoint(
+                        ts, [r.stats.get("retired_import_op", 0)
+                             for r in results])
                 except Exception:
                     self._engine_journal_failed("checkpoint")
             if self._forward_journal is not None:
@@ -2138,7 +2342,13 @@ class Server:
                     self._dedupe_journal.size_bytes()
                     if self._dedupe_journal is not None else None),
                 "engine_checkpoint": self._engine_checkpoint_state(),
+                # time-travel history tier (ISSUE 14): retained
+                # generations + query-path counters/cache
+                "history": (self._history.debug_state()
+                            if self._history is not None else None),
             },
+            "query": (self._query_tier.debug_state()
+                      if self._query_tier is not None else None),
             "registry": {
                 "server": self.telemetry.debug_state(),
                 "process": resilience.DEFAULT_REGISTRY.debug_state(),
