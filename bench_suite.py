@@ -2227,6 +2227,293 @@ def config18_incremental_flush():
           max_legacy / max(max_dbuf, 1e-6), "ratio", None)
 
 
+def config20_fused_kernels():
+    """Fused Pallas kernels (ISSUE 15): exec-only A/B rows — the flush
+    program built under the fused arm vs the XLA arm — at the c12 1.6k
+    and the c18 100k/10%-dirty shapes, for tdigest+hll AND req+ull,
+    plus the ULL scatter-join insert next to the c17 sort+scan
+    baseline.
+
+    On a CPU box the fused arm is the INTERPRET kernel (the knob=on
+    serving stance; bit-identity is pinned by tests/test_pallas.py) —
+    the acceptance gates here are "t-digest fused arm no slower than
+    XLA on CPU-interpret" and "ULL insert >= 5x faster than the c17
+    sort+scan row on the same box" (the c17 row and this one both time
+    a cold engine: the XLA arm's cost IS dominated by the
+    associative-scan compile each fresh serving process pays). The
+    HBM-round-trip win itself is asserted STRUCTURALLY (one
+    pallas_call per bucket program) pending the TPU capture
+    (capture_tpu_window.sh)."""
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.models import pipeline
+    from veneur_tpu.ops import tdigest
+    from veneur_tpu.sketches.hll_engine import HLLEngine
+    from veneur_tpu.sketches.tdigest_engine import TDigestEngine
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    fused_arm = "fused" if on_tpu else "interpret"
+    qs = np.asarray([0.5, 0.99], np.float32)
+    agg_emit = ("min", "max", "count")
+    rng = np.random.default_rng(20)
+    BUF = 256
+    _emit("c20_fused_arm_is_compiled", 1.0 if on_tpu else 0.0, "bool",
+          None, note=f"fused arm on this box = {fused_arm}")
+
+    def time_exec(fn, args, iters=3):
+        jax.block_until_ready(fn(*args))          # compile
+        out = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            out.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(out))
+
+    def mk_banks(K, dirty_ids):
+        """c18's worst-case bank shape: dirty rows carry a warm
+        centroid prefix + full sample buffer, cold rows fresh-init."""
+        from veneur_tpu.ops import hll, scalar
+        D = len(dirty_ids)
+        proto = tdigest.init(1, compression=100.0, buf_size=BUF)
+        c = proto.num_centroids
+        bv1 = rng.gamma(2.0, 20.0, (D, BUF)).astype(np.float32)
+        bv2 = rng.gamma(2.0, 20.0, (D, BUF)).astype(np.float32)
+        both = np.concatenate([bv1, bv2], axis=1)
+        small = tdigest.TDigestBank(
+            mean=np.zeros((D, c), np.float32),
+            weight=np.zeros((D, c), np.float32),
+            buf_value=bv1, buf_weight=np.ones((D, BUF), np.float32),
+            buf_n=np.full((D,), BUF, np.int32),
+            vmin=both.min(axis=1), vmax=both.max(axis=1),
+            vsum=both.sum(axis=1, dtype=np.float64).astype(np.float32),
+            count=np.full((D,), 2.0 * BUF, np.float32),
+            recip=(1.0 / both).sum(axis=1, dtype=np.float64).astype(
+                np.float32),
+            vsum_lo=np.zeros((D,), np.float32),
+            count_lo=np.zeros((D,), np.float32),
+            recip_lo=np.zeros((D,), np.float32))
+        small = tdigest.compress(jax.device_put(small, dev),
+                                 compression=100.0)
+        small = jax.device_get(small)
+        hb = jax.device_get(tdigest.init(K, 100.0, BUF))
+        for name in ("mean", "weight", "vmin", "vmax", "vsum", "count",
+                     "recip"):
+            arr = np.array(np.asarray(getattr(hb, name)))
+            arr[dirty_ids] = np.asarray(getattr(small, name))
+            hb = hb._replace(**{name: arr})
+        bw = np.array(np.asarray(hb.buf_value))
+        bw[dirty_ids] = bv2
+        hb = hb._replace(
+            buf_value=bw,
+            buf_weight=np.array(np.asarray(hb.buf_weight)),
+            buf_n=np.array(np.asarray(hb.buf_n)))
+        hb.buf_weight[dirty_ids] = 1.0
+        hb.buf_n[dirty_ids] = BUF
+        banks = (jax.device_put(hb, dev),
+                 jax.device_put(scalar.init_counters(64), dev),
+                 jax.device_put(scalar.init_gauges(64), dev),
+                 jax.device_put(hll.init(64, 14), dev))
+        jax.block_until_ready(banks)
+        return banks
+
+    heng = TDigestEngine(compression=100.0, buffer_depth=BUF)
+    seng = HLLEngine(precision=14)
+
+    # ---- tdigest+hll: full program at 1.6k, incremental at 100k/10%
+    def flush_ab(label, K, frac):
+        D = max(1, int(K * frac))
+        dirty_ids = np.sort(rng.choice(K, D, replace=False)) \
+            .astype(np.int32)
+        banks = mk_banks(K, dirty_ids)
+        rows = {}
+        for arm in ("xla", fused_arm):
+            if frac >= 1.0:
+                exe = pipeline._flush_executable(
+                    dev, heng, seng, False, agg_emit, False,
+                    donate=False, kernel_arm=arm)
+                ms = time_exec(exe, banks + (qs,))
+            else:
+                exe = pipeline._inc_flush_executable(
+                    dev, heng, seng, False, agg_emit, False,
+                    kernel_arm=arm)
+                one = np.zeros(1, np.int32)
+                idx = [pipeline.pad_dirty_ids(dirty_ids, K),
+                       pipeline.pad_dirty_ids(one, 64),
+                       pipeline.pad_dirty_ids(one, 64),
+                       pipeline.pad_dirty_ids(one, 64)]
+                ms = time_exec(exe, banks + (qs,) + tuple(idx))
+            rows[arm] = ms
+            _emit(f"c20_exec_{label}_{arm}_ms", ms, "ms", None,
+                  larger_is_better=False,
+                  note="exec-only (block_until_ready, no fetch), "
+                       "worst-case dirty rows")
+        _emit(f"c20_exec_{label}_xla_over_fused_x",
+              rows["xla"] / max(rows[fused_arm], 1e-6), "ratio", 1.0,
+              note="ACCEPTANCE GATE >= 1.0: fused arm no slower than "
+                   "XLA on this box (CPU boxes run the interpret "
+                   "kernel — same op sequence inside one pallas_call)")
+        del banks
+
+    flush_ab("tdigest_hll_1k6_full", 1024, 1.0)
+    flush_ab("tdigest_hll_100k_10pct", 100_000, 0.10)
+
+    # ---- req+ull: direct bank construction (REQ has no fused
+    # compress — the flush A/B documents the no-kernel arm staying at
+    # parity; ULL's own kernel lives on the INGEST path, priced below)
+    from veneur_tpu.sketches.req import REQEngine
+    from veneur_tpu.sketches.ull import ULLEngine
+
+    req = REQEngine(levels=2, capacity=256)
+    ull13 = ULLEngine(precision=13)
+
+    def scatter_rows(big, small, ids):
+        out = {}
+        for name in big._fields:
+            arr = np.array(np.asarray(getattr(big, name)))
+            arr[ids] = np.asarray(getattr(small, name))
+            out[name] = jnp.asarray(arr)
+        return jax.device_put(type(big)(**out), dev)
+
+    def flush_ab_req_ull(label, K, D):
+        from veneur_tpu.ops import scalar
+        dirty_ids = np.sort(rng.choice(K, D, replace=False)) \
+            .astype(np.int32)
+        # fill D rows of a small bank in ONE add_batch dispatch, then
+        # host-scatter the rows into a fresh full-K bank
+        per = 64
+        slots_s = np.repeat(np.arange(D, dtype=np.int32), per)
+        sh = jax.jit(req.add_batch_impl)(
+            req.init(D), jnp.asarray(slots_s),
+            jnp.asarray(rng.gamma(2.0, 20.0, D * per)
+                        .astype(np.float32)),
+            jnp.ones(D * per, jnp.float32))
+        hb = scatter_rows(jax.device_get(req.init(K)),
+                          jax.device_get(sh), dirty_ids)
+        sb = jax.device_put(ull13.init(64), dev)
+        banks = (hb, jax.device_put(scalar.init_counters(64), dev),
+                 jax.device_put(scalar.init_gauges(64), dev), sb)
+        jax.block_until_ready(banks)
+        one = np.zeros(1, np.int32)
+        idx = [pipeline.pad_dirty_ids(dirty_ids, K),
+               pipeline.pad_dirty_ids(one, 64),
+               pipeline.pad_dirty_ids(one, 64),
+               pipeline.pad_dirty_ids(one, 64)]
+        rows = {}
+        for arm in ("xla", fused_arm):
+            exe = pipeline._inc_flush_executable(
+                dev, req, ull13, False, agg_emit, False,
+                kernel_arm=arm)
+            ms = time_exec(exe, banks + (qs,) + tuple(idx))
+            rows[arm] = ms
+            _emit(f"c20_exec_{label}_{arm}_ms", ms, "ms", None,
+                  larger_is_better=False, dirty=int(D))
+        _emit(f"c20_exec_{label}_xla_over_fused_x",
+              rows["xla"] / max(rows[fused_arm], 1e-6), "ratio", None,
+              note="context, not a gate (the t-digest rows carry it): "
+                   "REQ has no fused compress, so both arms run the "
+                   "same XLA program and the ratio is pure "
+                   "measurement noise — it pins that the arm plumbing "
+                   "itself costs nothing on a no-kernel engine")
+        del banks
+
+    flush_ab_req_ull("req_ull_1k6", 1024, 102)
+    flush_ab_req_ull("req_ull_100k_10pct", 100_352, 10_035)
+
+    # ---- ULL scatter-join insert vs the c17 sort+scan row ----------
+    # Cold discipline mirrors c17: t0 before the first (compiling)
+    # dispatch of a fresh engine — the XLA arm's associative-scan
+    # compile is a cost every fresh serving process pays once per
+    # shape, and it dominated the c17 87us/member row. Warm rows give
+    # the steady-state comparison.
+    import functools as _ft
+
+    from veneur_tpu.kernels import ull_insert as _kins
+    from veneur_tpu.sketches.ull import ULLEngine, _insert_impl
+    from veneur_tpu.utils.hashing import set_member_hash
+
+    ull = ULLEngine(precision=13)
+    n, B = 100_000, 8192
+    hashes = np.array([set_member_hash(f"u{i}") for i in range(n)],
+                      np.uint64)
+    uidx, uvals = ull.host_hash_to_updates(hashes)
+
+    def insert_pass(f):
+        bank = ull.init(4)
+        t0 = time.monotonic()
+        for i in range(0, n, B):
+            seg = slice(i, min(n, i + B))
+            m_ = seg.stop - seg.start
+            s = np.full(B, -1, np.int32)
+            s[:m_] = 0
+            ip = np.zeros(B, np.int32)
+            ip[:m_] = uidx[seg]
+            vp = np.zeros(B, np.uint8)
+            vp[:m_] = uvals[seg]
+            bank = f(bank, jnp.asarray(s), jnp.asarray(ip),
+                     jnp.asarray(vp))
+        jax.block_until_ready(bank)
+        return (time.monotonic() - t0) * 1000, bank
+
+    arms = {
+        "xla": jax.jit(_insert_impl),
+        "fused": jax.jit(_ft.partial(_kins.fused_insert,
+                                     interpret=not on_tpu)),
+    }
+    cold, warm, banks_out = {}, {}, {}
+    for name, f in arms.items():
+        cold[name], banks_out[name] = insert_pass(f)   # incl. compile
+        warm[name], _ = insert_pass(f)
+        _emit(f"c20_ull_insert_100k_cold_ms_{name}", cold[name], "ms",
+              None, larger_is_better=False,
+              us_per_member=round(cold[name] * 1000 / n, 2),
+              note="cold (c17 discipline: compile included — the "
+                   "fresh-process serving cost)")
+        _emit(f"c20_ull_insert_100k_warm_ms_{name}", warm[name], "ms",
+              None, larger_is_better=False,
+              us_per_member=round(warm[name] * 1000 / n, 2))
+    assert np.array_equal(
+        np.asarray(banks_out["xla"].registers),
+        np.asarray(banks_out["fused"].registers)), \
+        "fused ULL insert diverged from the XLA path"
+    _emit("c20_ull_insert_speedup_cold_x",
+          cold["xla"] / max(cold["fused"], 1e-6), "ratio", 5.0,
+          note="ACCEPTANCE GATE >= 5x vs the c17 sort+scan row "
+               "discipline on the same box")
+    _emit("c20_ull_insert_speedup_warm_x",
+          warm["xla"] / max(warm["fused"], 1e-6), "ratio", None,
+          note="steady-state (both arms warm)")
+
+    # ---- structural: one pallas dispatch per bucket program --------
+    from veneur_tpu.ops import scalar as _scalar
+    body = pipeline._flush_program_body(
+        heng, HLLEngine(precision=10), False, agg_emit, False, False,
+        kernel_arm=fused_arm)
+    jaxpr = jax.make_jaxpr(body)(
+        heng.init(64), _scalar.init_counters(8),
+        _scalar.init_gauges(8), HLLEngine(precision=10).init(8), qs)
+
+    def count_pallas(jx):
+        total = 0
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                total += 1
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    total += count_pallas(v.jaxpr)
+        return total
+
+    _emit("c20_pallas_dispatches_per_bucket_program",
+          float(count_pallas(jaxpr.jaxpr)), "count", 1.0,
+          larger_is_better=False,
+          note="ACCEPTANCE (structural): the whole compress — sort + "
+               "rank-merge + cluster — is ONE pallas_call inside the "
+               "bucket's flush program; intermediates never re-enter "
+               "HBM between kernel dispatches (wall-clock win pends "
+               "the TPU capture)")
+
+
 def config19_wire_compression():
     """Bytes-on-the-wire A/B for the ISSUE 13 forward-path levers:
     full-lossless vs delta vs delta+quantized-centroid (q16), at the
@@ -2382,7 +2669,8 @@ CONFIGS = {1: config1_timer_only, 2: config2_mixed_counter_gauge,
            16: config16_engine_checkpoint,
            17: config17_sketch_engines,
            18: config18_incremental_flush,
-           19: config19_wire_compression}
+           19: config19_wire_compression,
+           20: config20_fused_kernels}
 
 
 def _run_isolated(configs: list[int], json_out: str) -> int:

@@ -99,4 +99,34 @@ if [ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)"' "$OUT/BENCH_c13_tpu.json
 else
     echo "c13 rc=$rc or not platform=tpu; keeping .tmp for forensics"
 fi
+
+# 5. Fused Pallas kernels on real TPU (ISSUE 15): bench_suite c20 runs
+#    the exec-only fused-vs-XLA A/B with the COMPILED Mosaic kernel
+#    (c20_fused_arm_is_compiled=1 confirms Mosaic accepted it; 0 means
+#    the probe refused and the arm fell back — check the log for the
+#    Mosaic error, that's the capture). This is the measurement the
+#    CPU-interpret rows in BENCH_SUITE_r14.json are a stand-in for:
+#    the HBM-round-trip win is structural there (one pallas_call per
+#    bucket) and becomes a wall-clock number here. It also gates the
+#    still-pending merge-path capture (step 2's compress A/B pair)
+#    that retires VENEUR_TPU_TDIGEST_FULL_SORT.
+timeout 540 python bench_suite.py --config 20 \
+    --json-out "$OUT/BENCH_c20_tpu.json.tmp" \
+    > "$OUT/tpu_window_c20_$TS.log" 2>&1
+rc=$?
+if [ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)"' "$OUT/BENCH_c20_tpu.json.tmp"; then
+    mv "$OUT/BENCH_c20_tpu.json.tmp" "$OUT/BENCH_c20_tpu.json"
+    echo "c20 fused-kernel TPU A/B captured (BENCH_c20_tpu.json)"
+else
+    echo "c20 rc=$rc or not platform=tpu; keeping .tmp for forensics"
+fi
+# Fused-vs-XLA PHASE TIMELINES against a live server: start one with
+# `tpu_fused_kernels: auto` + `debug_flush_profile: true`, then
+#     curl "http://$HTTP_ADDR/debug/flush/profile?ticks=3"
+#     curl "http://$HTTP_ADDR/debug/flush" | python -m json.tool
+# and read sketch_engines.kernels (histogram_arm/set_arm/fallback_total
+# name the arm every executable was ACTUALLY built with) next to the
+# device.exec phase rows; flip the knob to `off`, restart, re-curl —
+# the two /debug/flush captures are the fused-vs-XLA phase timeline
+# pair this window should check in.
 echo "window capture complete at $(date -u +%Y%m%dT%H%M%SZ)"

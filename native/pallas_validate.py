@@ -1,7 +1,7 @@
 """Validate the Pallas HLL estimator on REAL TPU hardware.
 
 VERDICT r2 weak #10: the Pallas streaming-stats kernel
-(ops/pallas_hll.py) only ever ran in interpret mode in CI; this script
+(kernels/hll_stats.py) only ever ran in interpret mode in CI; this script
 runs it on the actual chip against the pure-jnp estimator over adversarial
 register patterns and random banks, checks bitwise/near equality, and
 measures the HBM-bandwidth win. Run from the repo root (the axon plugin
@@ -35,7 +35,7 @@ def main() -> int:
     import jax.numpy as jnp
 
     from veneur_tpu.ops import hll
-    from veneur_tpu.ops.pallas_hll import hll_stats
+    from veneur_tpu.kernels.hll_stats import hll_stats
 
     rng = np.random.default_rng(0)
     K, m = 4096, 1 << 14

@@ -1,11 +1,13 @@
-"""Environment probes for the KNOWN environmental tier-1 failures.
+"""Environment probes for the KNOWN environmental tier-1 skips.
 
 Two capabilities are missing from this container and have failed the
 same 15 tests since the features landed (mesh `shard_map` API drift,
-the `cryptography` package absent for TLS cert minting). Gating them
-behind precise probes turns tier-1 into green-or-skipped instead of
-"same 15 fails as baseline" — a NEW failure is immediately visible
-instead of hiding in a familiar count.
+the `cryptography` package absent for TLS cert minting); a third pair
+(ISSUE 15) gates the fused-kernel arms — pallas interpret mode for
+the CPU bit-identity tests, a Mosaic-accepting TPU backend for the
+compiled arm. Gating them behind precise probes turns tier-1 into
+green-or-skipped instead of "same N fails as baseline" — a NEW
+failure is immediately visible instead of hiding in a familiar count.
 
 The probes are deliberately narrow: each tests EXACTLY the capability
 its gated tests consume (the top-level `jax.shard_map` symbol; the
@@ -32,6 +34,35 @@ MESH_SKIP_REASON = (
     "export; this interpreter only ships jax.experimental.shard_map)")
 needs_mesh_shard_map = pytest.mark.skipif(MESH_SHARD_MAP_MISSING,
                                           reason=MESH_SKIP_REASON)
+
+# -- pallas: interpret-mode + TPU-compiled kernel arms -----------------
+# The fused-kernel tests (tests/test_pallas.py) run the kernels under
+# `interpret=True` on CPU — the bit-identity proof needs exactly the
+# pallas interpreter, probed by running a trivial kernel through it.
+# The TPU-COMPILED arm additionally needs a tpu/axon backend whose
+# Mosaic accepts the real compress kernel; absent hardware it
+# env-skips exactly like the mesh tests (the probe compiles the actual
+# kernel, so a Mosaic primitive refusal reads as "missing" too — the
+# serving path then runs the counted XLA fallback, which is what the
+# skip documents).
+from veneur_tpu import kernels as _kernels
+
+PALLAS_INTERPRET_MISSING = not _kernels.probe_interpret()
+PALLAS_INTERPRET_SKIP_REASON = (
+    "environmental: this jax cannot run pallas_call(interpret=True) — "
+    "the CPU bit-identity arm of the fused kernels has nothing to "
+    "execute (serving degrades to the counted XLA fallback)")
+needs_pallas_interpret = pytest.mark.skipif(
+    PALLAS_INTERPRET_MISSING, reason=PALLAS_INTERPRET_SKIP_REASON)
+
+PALLAS_TPU_COMPILE_MISSING = not _kernels.probe_compiled()
+PALLAS_TPU_SKIP_REASON = (
+    "environmental: no tpu/axon backend (or Mosaic refused the "
+    "compress kernel) — the compiled fused arm cannot build here; "
+    "interpret-mode tests prove the kernel math on CPU and "
+    "capture_tpu_window.sh stages the hardware validation")
+needs_pallas_tpu = pytest.mark.skipif(
+    PALLAS_TPU_COMPILE_MISSING, reason=PALLAS_TPU_SKIP_REASON)
 
 # -- TLS: the cryptography package ------------------------------------
 # The TLS statsd tests mint self-signed certs with `cryptography`

@@ -52,3 +52,44 @@ def test_probes_are_derived_not_hardcoded():
     assert MESH_SHARD_MAP_MISSING == (not hasattr(jax, "shard_map"))
     assert CRYPTOGRAPHY_MISSING == (
         importlib.util.find_spec("cryptography") is None)
+
+
+def test_pallas_interpret_probe_matches_reality():
+    from envprobes import (PALLAS_INTERPRET_MISSING,
+                           PALLAS_INTERPRET_SKIP_REASON)
+    assert PALLAS_INTERPRET_SKIP_REASON.startswith("environmental:")
+    if PALLAS_INTERPRET_MISSING:
+        # the gated tests would die constructing/running a trivial
+        # interpret-mode kernel — the probe must imply that failure
+        with pytest.raises(Exception):
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def k(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            pl.pallas_call(
+                k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                interpret=True)(jnp.zeros((8, 128), jnp.float32))
+    else:
+        # present: the capability the fused-kernel parity tests consume
+        # must actually produce numbers
+        import numpy as np
+
+        from veneur_tpu.kernels.hll_stats import hll_stats
+        regs = np.zeros((4, 512), np.uint8)
+        ez, zsum = hll_stats(regs, interpret=True)
+        assert float(np.asarray(ez)[0]) == 512.0
+
+
+def test_pallas_tpu_probe_matches_reality():
+    from envprobes import (PALLAS_TPU_COMPILE_MISSING,
+                           PALLAS_TPU_SKIP_REASON)
+    assert PALLAS_TPU_SKIP_REASON.startswith("environmental:")
+    from veneur_tpu import kernels
+    # the probe IS the capability (it compiles the real kernel), so
+    # re-deriving it must agree; on a non-TPU platform it must be
+    # missing by definition
+    assert PALLAS_TPU_COMPILE_MISSING == (not kernels.probe_compiled())
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        assert PALLAS_TPU_COMPILE_MISSING

@@ -310,3 +310,32 @@ def test_qt01_out_of_scope_modules_unchecked():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(repo, "veneur_tpu", "models", "pipeline.py")
     assert [v for v in run_paths([path]) if v.rule == "QT01"] == []
+
+
+def test_pk01_pallas_outside_kernels_package():
+    # both import spellings + the pallas_call invocation; the
+    # suppressed entry and the attribute-only use stay silent
+    assert lint("pk01_bad.py") == [("PK01", 6), ("PK01", 7),
+                                   ("PK01", 16)]
+
+
+def test_pk01_kernel_entry_without_counted_fallback():
+    # flagged: the bare delegating entry, the direct entry, the entry
+    # that only READS fallback_total (a getter is not a degradation
+    # branch), and the class METHOD reaching pallas_call. Silent: the
+    # guarded entry, the entry delegating to it, the guarded method,
+    # the private helpers, and the non-kernel helper
+    assert lint("pk01_kernels_bad.py") == [("PK01", 25), ("PK01", 29),
+                                           ("PK01", 56), ("PK01", 64)]
+
+
+def test_pk01_shipping_tree_is_clean():
+    # the invariant the check exists for: every pl.* primitive lives
+    # in veneur_tpu/kernels/ with counted-fallback entry points, and
+    # the kernel consumers (ops/hll.py, the pipeline, the engines)
+    # never touch pallas directly
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(repo, "veneur_tpu", p) for p in
+             ("kernels", "ops", os.path.join("models", "pipeline.py"),
+              "sketches")]
+    assert [v for v in run_paths(paths) if v.rule == "PK01"] == []

@@ -1,0 +1,29 @@
+"""PK01 fixture, leg (a): pallas imports + pallas_call invocations
+OUTSIDE veneur_tpu/kernels/. The filename carries the /pk01_ scope
+marker (and not the /pk01_kernels_ one, so this lints as a non-kernel
+module). Line numbers are pinned by tests/test_vlint.py."""
+
+from jax.experimental import pallas as pl                    # PK01
+from jax.experimental.pallas import tpu as pltpu             # PK01
+
+import jax
+
+
+def rogue_kernel(x):
+    def body(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    return pl.pallas_call(                                   # PK01
+        body, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+
+def suppressed_kernel(x):
+    # vlint: disable=PK01 reason=fixture-only: demonstrating the
+    # suppression syntax for a documented out-of-package kernel
+    return pl.pallas_call(
+        lambda i, o: None, out_shape=None)(x)
+
+
+def uses_vmem_spec():
+    return pltpu.VMEM                                        # ok (import
+    # already flagged once; attribute use alone is not re-reported)

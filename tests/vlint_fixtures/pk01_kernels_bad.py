@@ -1,0 +1,73 @@
+"""PK01 fixture, leg (b): a kernels-package module (the filename
+carries the /pk01_kernels_ scope marker) whose PUBLIC entry points
+reach pallas_call without a counted fallback branch. Line numbers are
+pinned by tests/test_vlint.py."""
+
+from jax.experimental import pallas as pl
+
+import jax
+
+
+def count_fallback(reason):
+    pass
+
+
+def _kernel_body(x_ref, o_ref):
+    o_ref[:] = x_ref[:] + 1.0
+
+
+def _call_kernel(x):
+    return pl.pallas_call(
+        _kernel_body, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )(x)
+
+
+def bare_entry(x):                                           # PK01
+    return _call_kernel(x)
+
+
+def direct_entry(x):                                         # PK01
+    return pl.pallas_call(
+        _kernel_body, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )(x)
+
+
+def guarded_entry(x):                                        # ok
+    if x is None:
+        count_fallback("backend refused")
+        return x
+    return _call_kernel(x)
+
+
+def delegating_entry(x):                                     # ok —
+    # inherits the branch from guarded_entry (the one owner)
+    return guarded_entry(x)
+
+
+def plain_helper(x):                                         # ok —
+    # never reaches a pallas_call
+    return x + 1
+
+
+def fallback_total():
+    return 0
+
+
+def reporting_entry(x):                                      # PK01 —
+    # READING the counter (the /debug getter) is not a degradation
+    # branch; only count_fallback is
+    _ = fallback_total()
+    return _call_kernel(x)
+
+
+class KernelWrapper:
+    def method_entry(self, x):                               # PK01 —
+        # class methods are entry points too
+        return pl.pallas_call(
+            _kernel_body, out_shape=None)(x)
+
+    def guarded_method(self, x):                             # ok
+        if x is None:
+            count_fallback("backend refused")
+            return x
+        return self.method_entry(x)

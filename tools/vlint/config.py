@@ -43,6 +43,10 @@ DEFAULT_CONFIG = {
     "sr02_allow": (
         "veneur_tpu/ops/tdigest.py",
         "veneur_tpu/sketches/req.py",
+        # the fused compress kernel (ISSUE 15) is a second
+        # invariant-preserving writer: its cummax clamp is pinned
+        # bit-identical to _cluster_core's by tests/test_pallas.py
+        "veneur_tpu/kernels/compress.py",
     ),
     # DR01: where the durable-state write discipline applies (path
     # substring match; the /dr01_ entry scopes the check's own test
@@ -111,6 +115,9 @@ DEFAULT_CONFIG = {
         "veneur_tpu/sketches/",
         "veneur_tpu/ops/",
         "veneur_tpu/parallel/",
+        # the fused-kernel twins of the ops/ math (ISSUE 15): they ARE
+        # sketch implementations and share the ops/ definitions
+        "veneur_tpu/kernels/",
     ),
     # DS01: dirty-bitmap marking discipline (path substring match;
     # /ds01_ scopes the check's own fixture in): every device-landing
@@ -154,5 +161,20 @@ DEFAULT_CONFIG = {
     "qt01_scope": (
         "veneur_tpu/durability/history.py",
         "/qt01_",
+    ),
+    # PK01: pallas-kernel containment (ISSUE 15; path substring match,
+    # /pk01_ scopes the check's own fixtures in): pl.* imports and
+    # pallas_call invocations outside veneur_tpu/kernels/ are flagged,
+    # and inside the package every public entry reaching a pallas_call
+    # must carry a counted fallback branch (count_fallback ->
+    # veneur.kernels.fallback_total). pk01_kernel_paths names the
+    # kernel-package scope (the fixtures' path rides along).
+    "pk01_scope": (
+        "veneur_tpu/",
+        "/pk01_",
+    ),
+    "pk01_kernel_paths": (
+        "veneur_tpu/kernels/",
+        "/pk01_kernels_",
     ),
 }

@@ -1,5 +1,5 @@
 """Python AST passes: JX01, JX02, JX03, TH01, CF01, RS01, SR02, DR01,
-DR02, TL01, OV01, SK01, DS01, QT01.
+DR02, TL01, OV01, SK01, DS01, QT01, PK01.
 
 All checks are intentionally conservative: they resolve only what can
 be resolved statically within the project (local jit wrappers, module
@@ -1096,11 +1096,14 @@ def check_ov01(mod: PyModule, config: dict) -> list[Violation]:
 _SK01_BANKS = ("TDigestBank", "HLLBank", "ULLBank", "REQBank")
 # module tails that ARE sketch implementations: importing one outside
 # the registry boundary is direct sketch-math access
-_SK01_MODULES = ("ops.tdigest", "ops.hll", "ops.pallas_hll",
+_SK01_MODULES = ("ops.tdigest", "ops.hll",
                  "sketches.ull", "sketches.req",
-                 "sketches.tdigest_engine", "sketches.hll_engine")
-_SK01_LEAF_NAMES = ("tdigest", "hll", "pallas_hll", "ull", "req",
-                    "tdigest_engine", "hll_engine")
+                 "sketches.tdigest_engine", "sketches.hll_engine",
+                 "kernels.compress", "kernels.ull_insert",
+                 "kernels.hll_stats")
+_SK01_LEAF_NAMES = ("tdigest", "hll", "ull", "req",
+                    "tdigest_engine", "hll_engine",
+                    "compress", "ull_insert", "hll_stats")
 
 
 def check_sk01(mod: PyModule, config: dict) -> list[Violation]:
@@ -1130,10 +1133,12 @@ def check_sk01(mod: PyModule, config: dict) -> list[Violation]:
                       for t in _SK01_MODULES)
             names = {a.name for a in node.names}
             # `from ..ops import tdigest, hll` / `from ..sketches
-            # import ull` forms: the module is the parent package and
-            # the implementation rides in the names list
+            # import ull` / `from ..kernels import compress` forms:
+            # the module is the parent package and the implementation
+            # rides in the names list
             if not hit and (module.endswith("ops")
-                            or module.endswith("sketches")):
+                            or module.endswith("sketches")
+                            or module.endswith("kernels")):
                 hit = bool(names & set(_SK01_LEAF_NAMES))
             if hit:
                 out.append(Violation(
@@ -1162,6 +1167,164 @@ def check_sk01(mod: PyModule, config: dict) -> list[Violation]:
                     "invariants (cluster order, register packing, "
                     "level layout); build through the engine object or "
                     "suppress with a reason"))
+    return out
+
+
+# ------------------------------------------------------------------- PK01
+
+
+def _pk01_pallas_imports(tree: ast.AST) -> list:
+    """(lineno, spelling) for every import of a pallas module."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            if "pallas" in module:
+                out.append((node.lineno, module))
+            elif module.endswith("jax.experimental") or \
+                    module == "jax.experimental":
+                for a in node.names:
+                    if a.name == "pallas":
+                        out.append((node.lineno, module + ".pallas"))
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if "pallas" in a.name:
+                    out.append((node.lineno, a.name))
+    return out
+
+
+def _pk01_counts_fallback(fn: ast.AST) -> bool:
+    """Does this function call THE fallback counter, count_fallback?
+    Exact-match on the final name component: a function that merely
+    READS the counter (fallback_total, a /debug getter) has no
+    degradation branch and must not pass for one."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None and \
+                    d.rsplit(".", 1)[-1] == "count_fallback":
+                return True
+    return False
+
+
+def _pk01_functions(tree: ast.AST):
+    """Module-level functions AND class methods (sync + async) — the
+    entry-point surface leg (b) disciplines. Nested closures are
+    excluded: kernel-body helpers defined inside an entry are part of
+    that entry's own accounting."""
+    for n in tree.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n
+        elif isinstance(n, ast.ClassDef):
+            for m in n.body:
+                if isinstance(m, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    yield m
+
+
+def check_pk01(mod: PyModule, config: dict) -> list[Violation]:
+    """Pallas-kernel containment (ISSUE 15). Two legs:
+
+    (a) OUTSIDE veneur_tpu/kernels/, importing a pallas module or
+        calling `pallas_call` is flagged — every pl.* primitive is
+        single-homed in the kernels package, where the arm-resolution/
+        probe/fallback machinery guarantees a refused backend degrades
+        loudly instead of crashing a serving executable.
+    (b) INSIDE the kernels package, every PUBLIC function that reaches
+        a `pallas_call` (directly or through module-local helpers)
+        must contain a counted fallback branch — a call to the
+        `count_fallback` helper (veneur.kernels.fallback_total) — so
+        no kernel entry point can silently lack the degradation path.
+        Availability probes suppress with a reason (resolve_arm owns
+        their fallback accounting)."""
+    in_kernels = any(k in mod.path
+                     for k in config["pk01_kernel_paths"])
+    in_scope = any(s in mod.path for s in config["pk01_scope"])
+    if not (in_scope or in_kernels):
+        return []
+    out = []
+    if not in_kernels:
+        for lineno, spelling in _pk01_pallas_imports(mod.tree):
+            out.append(Violation(
+                mod.path, lineno, "PK01",
+                f"pallas import ({spelling!r}) outside "
+                "veneur_tpu/kernels/ — kernels are single-homed there "
+                "behind the arm/probe/fallback machinery; move the "
+                "kernel or suppress with a reason"))
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is not None and \
+                        d.rsplit(".", 1)[-1] == "pallas_call":
+                    out.append(Violation(
+                        mod.path, node.lineno, "PK01",
+                        "pallas_call outside veneur_tpu/kernels/ — "
+                        "kernel invocations live in the kernels "
+                        "package (counted-fallback discipline); move "
+                        "it or suppress with a reason"))
+        return out
+
+    # leg (b): entry-point fallback discipline inside the package
+    funcs = {n.name: n for n in _pk01_functions(mod.tree)}
+    direct = {}
+    calls_local = {}
+    for name, fn in funcs.items():
+        has = False
+        called = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is None:
+                    continue
+                leaf = d.rsplit(".", 1)[-1]
+                if leaf == "pallas_call":
+                    has = True
+                # match module-local callees by final name component
+                # so `self.helper()` / `cls.helper()` resolve too
+                if leaf in funcs:
+                    called.add(leaf)
+        direct[name] = has
+        calls_local[name] = called
+    reaches = dict(direct)
+    for _ in range(len(funcs)):      # fixed-point over the call graph
+        changed = False
+        for name in funcs:
+            if not reaches[name] and any(reaches[c]
+                                         for c in calls_local[name]):
+                reaches[name] = True
+                changed = True
+        if not changed:
+            break
+    # a function is protected when it counts the fallback itself, or
+    # every kernel it reaches is reached THROUGH a protected callee
+    # (delegating entry points like fused_compress_bank inherit the
+    # branch from the one entry that owns it)
+    protected = {name: _pk01_counts_fallback(fn)
+                 for name, fn in funcs.items()}
+    for _ in range(len(funcs)):
+        changed = False
+        for name in funcs:
+            if protected[name] or direct[name]:
+                continue
+            kernel_callees = [c for c in calls_local[name]
+                              if reaches[c]]
+            if kernel_callees and all(protected[c]
+                                      for c in kernel_callees):
+                protected[name] = True
+                changed = True
+        if not changed:
+            break
+    for name, fn in funcs.items():
+        if name.startswith("_") or not reaches[name]:
+            continue
+        if not protected[name]:
+            out.append(Violation(
+                mod.path, fn.lineno, "PK01",
+                f"kernel entry point {name!r} reaches pallas_call "
+                "without a counted fallback branch — every public "
+                "kernel entry must degrade to the XLA program through "
+                "count_fallback (veneur.kernels.fallback_total) when "
+                "the backend refuses, or suppress with a reason"))
     return out
 
 
@@ -1369,4 +1532,5 @@ def check_module(mod: PyModule, ctx: Context, config: dict
     out.extend(check_sk01(mod, config))
     out.extend(check_ds01(mod, config))
     out.extend(check_qt01(mod, config))
+    out.extend(check_pk01(mod, config))
     return out

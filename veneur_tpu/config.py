@@ -362,6 +362,15 @@ class Config:
     # executable or materialize. Off = legacy drain-under-lock
     # ordering (the mesh engine always uses legacy).
     tpu_flush_double_buffer: bool = True
+    # Fused Pallas kernels (ISSUE 15): one-kernel-per-bucket compress
+    # (t-digest sort+rank-merge+cluster with VMEM intermediates) and
+    # the ULL scatter-join insert. "auto" = compiled kernels on real
+    # TPU backends with a loud, counted fallback to the XLA programs
+    # (veneur.kernels.fallback_total) when Mosaic refuses; XLA on CPU.
+    # "on" additionally serves interpret-mode kernels on CPU (testing
+    # stance; bit-identical to XLA by contract). "off" = XLA only.
+    # /debug/flush sketch_engines.kernels reports the built arms.
+    tpu_fused_kernels: str = "auto"
 
     # --- native C++ ingest bridge (native/vtpu_ingest.cpp) ---
     # When on, UDP DogStatsD ingest (readers + parse + key interning +
@@ -603,6 +612,18 @@ def _validate(cfg: Config) -> None:
         raise ValueError(
             "tpu_flush_incremental_threshold must be in (0, 1]: the "
             "dirty fraction above which the full flush program runs")
+    if cfg.tpu_fused_kernels not in ("auto", "on", "off"):
+        raise ValueError(
+            "tpu_fused_kernels must be one of auto/on/off")
+    if cfg.tpu_fused_kernels != "off" and cfg.tpu_num_devices > 1:
+        # the mesh engine builds its own sharded flush program and
+        # never consults the kernel arm — not an error (auto is the
+        # default everywhere), but "on" deserves a loud note
+        if cfg.tpu_fused_kernels == "on":
+            log.warning(
+                "tpu_fused_kernels=on is ignored with "
+                "tpu_num_devices > 1: the mesh engine serves its own "
+                "sharded flush program (XLA arm)")
     # t-digest centroid capacity is ~2*compression (fixed 100), padded to
     # 128 lanes. A buffer shallower than that makes the global import
     # path pay ceil(C/B) compress dispatches per landing round —

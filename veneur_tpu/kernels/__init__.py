@@ -1,0 +1,255 @@
+"""Pallas TPU kernels — the fused-kernel layer of the flush hot path.
+
+ISSUE 15: the flight recorder attributes ~94% of the 100k tick to
+`device.exec`, and with the incremental flush (ISSUE 11) bounding the
+work set to the dirty [D, C] slice, the one structural lever left is
+killing the HBM round-trips BETWEEN the compress stages: XLA
+materializes the sort keys, the merged runs, and the cumsum/cluster
+intermediates as [D, M] HBM arrays between fused subcomputations. The
+kernels here fuse each hot path into ONE `pallas_call` whose
+intermediates live in VMEM:
+
+  compress.py   packed-key sort of the sample buffer + log-depth
+                bitonic rank-merge against the cluster-ordered centroid
+                prefix + greedy k1 cluster/cummax-clamp — the whole
+                t-digest compress, one kernel invocation per bucket.
+  ull_insert.py scatter-join insert for UltraLogLog register banks —
+                sequential lattice-join RMW replacing the XLA-CPU
+                sort + segmented-scan + gather path (~87us/member,
+                BENCH_SUITE_r11 c17).
+  hll_stats.py  the streaming HLL estimate reduction (moved from
+                ops/pallas_hll.py — every pl.* primitive in the tree
+                now lives under this package, machine-checked by
+                vlint PK01).
+
+ARM MODEL (the `tpu_fused_kernels` knob): every kernel-routed
+executable is built under exactly one arm —
+
+  "fused"      the Mosaic-compiled kernel on a real TPU backend;
+  "interpret"  the same kernel under `interpret=True` — the CPU
+               testing arm that proves BIT-IDENTITY against the XLA
+               program without hardware (tier-1's correctness bar);
+  "xla"        the existing XLA program, untouched.
+
+`resolve_arm` maps the knob (auto|on|off) + the backend platform to an
+arm through runtime probes; any refusal (Pallas missing, Mosaic
+rejecting a primitive, the probe kernel failing) degrades LOUDLY to
+"xla": a warning is logged and `veneur.kernels.fallback_total` counts
+it — vlint PK01 additionally requires every kernel entry point in this
+package to carry such a counted fallback branch, so a refused backend
+can never silently serve a half-fused program.
+
+Bit-identity contract (tests/test_pallas.py): under the "interpret"
+arm every kernel reproduces its XLA twin EXACTLY — including ±0.0
+canonicalization in the sort keys, duplicate-key stability, NaN
+payload bits riding the payload lanes, and the SR02 cummax ordering
+invariant — because the sort/merge networks are order-isomorphic to
+the XLA path's (distinct lexicographic (key, tag) pairs have ONE
+ascending order) and the numeric stages run the identical jnp ops on
+identical inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+logger = logging.getLogger(__name__)
+
+ARMS = ("fused", "interpret", "xla")
+MODES = ("auto", "on", "off")
+
+
+def count_fallback(reason: str):
+    """Count + log one kernel->XLA degradation. Every kernel entry
+    point's fallback branch routes through here (vlint PK01): the
+    counter is `veneur.kernels.fallback_total` on the process registry,
+    surfaced at /debug/flush next to the per-engine arm stamps."""
+    from ..observe.registry import DEFAULT_REGISTRY, SERVER_SCOPE
+    DEFAULT_REGISTRY.incr(SERVER_SCOPE, "kernels.fallback")
+    logger.warning("fused-kernel fallback to the XLA program: %s",
+                   reason)
+
+
+def fallback_total() -> int:
+    """Cumulative kernel->XLA degradations this process (/debug)."""
+    from ..observe.registry import DEFAULT_REGISTRY, SERVER_SCOPE
+    return DEFAULT_REGISTRY.total(SERVER_SCOPE, "kernels.fallback")
+
+
+@functools.lru_cache(maxsize=None)
+# vlint: disable=PK01 reason=availability probe, not a serving entry
+# point — resolve_arm owns the counted fallback when this is False
+def probe_interpret() -> bool:
+    """Can this jax run a trivial `pallas_call(interpret=True)`? The
+    EXACT capability the interpret arm (and its tier-1 tests) consume;
+    tests/envprobes.py gates on this probe."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def k(x_ref, o_ref):
+            o_ref[:] = x_ref[:] + 1.0
+
+        out = pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=True)(jnp.zeros((8, 128), jnp.float32))
+        return bool(out[0, 0] == 1.0)
+    except Exception as e:          # noqa: BLE001 — any failure = absent
+        logger.info("pallas interpret probe failed: %s", e)
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def probe_compiled() -> bool:
+    """Can the REAL fused compress kernel compile on this backend?
+    Probes with a tiny instance of the actual kernel (not a toy): a
+    Mosaic refusal of any primitive the kernel uses must surface HERE,
+    at arm-resolution time, so serving degrades to XLA before the
+    first flush — never mid-tick. False on non-TPU platforms (the
+    compiled arm only exists on tpu/axon; CPU uses interpret)."""
+    try:
+        import jax
+        if jax.devices()[0].platform not in ("tpu", "axon"):
+            return False
+        import jax.numpy as jnp
+
+        from . import compress
+        mean = jnp.zeros((8, 128), jnp.float32)
+        weight = jnp.zeros((8, 128), jnp.float32)
+        bv = jnp.zeros((8, 8), jnp.float32)
+        bw = jnp.zeros((8, 8), jnp.float32)
+        jax.jit(lambda *a: compress.fused_compress(
+            *a, compression=40.0, interpret=False)).lower(
+            mean, weight, bv, bw).compile()
+        return True
+    except Exception as e:          # noqa: BLE001 — refusal = fallback
+        logger.info("pallas compiled probe failed: %s", e)
+        return False
+
+
+def verify_engine_kernels(heng, seng, arms: dict, set_slots: int,
+                          batch_size: int) -> dict:
+    """Shape-accurate second-stage probe for the COMPILED arm.
+
+    `probe_compiled()` proves Mosaic accepts the compress kernel at a
+    toy shape; Mosaic refusals can also be SHAPE-dependent (VMEM
+    overflow at a deep buffer, tile misalignment, a register file too
+    wide for one block), and the ULL insert kernel is a different
+    program entirely. So before an engine serves the "fused" arm,
+    AOT-compile each fused kernel it will actually dispatch at the
+    ENGINE'S serving shapes — the compress at its real centroid/buffer
+    widths over the fixed row block, the insert at the real
+    [set_slots, m] register file and batch width — and demote that
+    engine's arm to the counted XLA fallback on refusal, at
+    CONSTRUCTION time, never mid-tick. interpret/xla arms pass through
+    untouched (no Mosaic involved)."""
+    out = dict(arms)
+
+    def _compiles(build, what: str) -> bool:
+        try:
+            build()
+            return True
+        except Exception as e:      # noqa: BLE001 — refusal = fallback
+            logger.info("%s refused at serving shape: %s", what, e)
+            return False
+
+    if out.get("histogram") == "fused" \
+            and hasattr(heng, "compress_fused_impl"):
+        import jax
+        import jax.numpy as jnp
+
+        from . import compress as _compress
+        proto = heng.init(1)
+        C, B = int(proto.num_centroids), int(proto.buf_size)
+        R = _compress._BLOCK_ROWS
+        comp = float(getattr(heng, "compression", 100.0))
+        f32 = jnp.float32
+
+        def build_compress():
+            jax.jit(lambda m, w, bv, bw: _compress.fused_compress(
+                m, w, bv, bw, compression=comp, interpret=False)
+            ).lower(
+                jax.ShapeDtypeStruct((R, C), f32),
+                jax.ShapeDtypeStruct((R, C), f32),
+                jax.ShapeDtypeStruct((R, B), f32),
+                jax.ShapeDtypeStruct((R, B), f32),
+            ).compile()
+
+        if not _compiles(build_compress, "fused compress"):
+            count_fallback(
+                f"fused compress refused at serving shape C={C} "
+                f"B={B} (block {R}) — this engine keeps the XLA "
+                "compress")
+            out["histogram"] = "xla"
+    if out.get("set") == "fused" and hasattr(seng, "insert_fused_impl"):
+        import jax
+        import jax.numpy as jnp
+
+        from . import ull_insert as _ull_insert
+        bank_aval = jax.eval_shape(lambda: seng.init(set_slots))
+
+        def build_insert():
+            jax.jit(lambda b, s, i, v: _ull_insert.fused_insert(
+                b, s, i, v, interpret=False)
+            ).lower(
+                bank_aval,
+                jax.ShapeDtypeStruct((batch_size,), jnp.int32),
+                jax.ShapeDtypeStruct((batch_size,), jnp.int32),
+                jax.ShapeDtypeStruct((batch_size,), jnp.uint8),
+            ).compile()
+
+        if not _compiles(build_insert, "fused ULL insert"):
+            count_fallback(
+                f"fused ULL insert refused at serving shape "
+                f"[{set_slots}, {getattr(seng, 'num_registers', '?')}] "
+                f"x batch {batch_size} — this engine keeps the XLA "
+                "insert")
+            out["set"] = "xla"
+    return out
+
+
+def resolve_arm(mode: str, platform: str | None = None) -> str:
+    """Map the `tpu_fused_kernels` knob to the arm every kernel-routed
+    executable is built under.
+
+      off   -> "xla" always.
+      auto  -> "fused" on a TPU backend whose probe passes (counted
+               fallback to "xla" when Mosaic refuses); "xla" on CPU —
+               the interpret arm is a CORRECTNESS harness, not a
+               serving default (it simulates the kernel).
+      on    -> like auto on TPU; on CPU the interpret arm serves (the
+               testing stance: the oracle/chaos suites run the actual
+               kernel math through the whole pipeline), with a counted
+               fallback when even interpret is unavailable.
+    """
+    if mode not in MODES:
+        raise ValueError(
+            f"tpu_fused_kernels must be one of {'/'.join(MODES)}, "
+            f"got {mode!r}")
+    if mode == "off":
+        return "xla"
+    if platform is None:
+        try:
+            import jax
+            platform = jax.devices()[0].platform
+        except Exception:           # noqa: BLE001 — no backend = no kernel
+            count_fallback("no jax backend available")
+            return "xla"
+    if platform in ("tpu", "axon"):
+        if probe_compiled():
+            return "fused"
+        count_fallback(
+            f"tpu_fused_kernels={mode} on {platform} but the compress "
+            "kernel did not compile (Mosaic refusal — see the probe "
+            "log line)")
+        return "xla"
+    if mode == "on":
+        if probe_interpret():
+            return "interpret"
+        count_fallback(
+            "tpu_fused_kernels=on without a TPU backend and "
+            "pallas interpret mode unavailable")
+        return "xla"
+    return "xla"

@@ -16,6 +16,8 @@ beta-polynomial arithmetic stays in plain jnp outside the kernel.
 
 Use `hll_stats(registers, interpret=True)` on CPU for tests; on TPU the
 real kernel runs. ops/hll.py picks this path automatically on TPU.
+(Moved here from ops/pallas_hll.py — vlint PK01 single-homes every
+pl.* primitive under veneur_tpu/kernels/.)
 """
 
 from __future__ import annotations
@@ -24,8 +26,16 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from . import count_fallback
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_ERR = None
+except Exception as _e:             # noqa: BLE001 — probed at entry
+    pl = pltpu = None
+    _PALLAS_ERR = _e
 
 # u8 min tile is (32, 128); BK=32 rows keeps every block aligned.
 _BK = 32
@@ -53,14 +63,30 @@ def _stats_kernel(regs_ref, ez_ref, zsum_ref):
     zsum_ref[:] = jnp.sum(zsum_acc, axis=1, keepdims=True)
 
 
+def _stats_jnp(registers):
+    """The plain-jnp twin (the fallback arm): identical statistics
+    without the streaming pass — what ops/hll._estimate_jnp reduces."""
+    ez = jnp.sum(registers == 0, axis=1).astype(jnp.float32)
+    zsum = jnp.sum(jnp.exp2(-registers.astype(jnp.float32)), axis=1)
+    return ez, zsum
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def hll_stats(registers, interpret: bool = False):
     """(ez[K], zsum[K]) for a u8[K, m] register bank via one streaming
-    pass. m must be a multiple of 512 (every real precision >= 9 is);
-    K is padded up to the 32-row block internally."""
+    pass. K is padded up to the 32-row block internally.
+
+    Counted fallback branch (vlint PK01): a register width off the
+    512-lane chunk grid (no real precision >= 9 hits this) or an
+    unavailable pallas degrades to the jnp reduction — same
+    statistics, no streaming claim."""
     K, m = registers.shape
     if m % _LANES != 0:
-        raise ValueError(f"m={m} not a multiple of {_LANES}")
+        count_fallback(f"hll_stats: m={m} not a multiple of {_LANES}")
+        return _stats_jnp(registers)
+    if pl is None:
+        count_fallback(f"hll_stats: pallas unavailable ({_PALLAS_ERR})")
+        return _stats_jnp(registers)
     Kp = (K + _BK - 1) // _BK * _BK
     if Kp != K:
         registers = jnp.pad(registers, ((0, Kp - K), (0, 0)))

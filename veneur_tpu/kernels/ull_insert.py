@@ -1,0 +1,82 @@
+"""Pallas scatter-join insert for UltraLogLog register banks.
+
+The XLA insert (sketches/ull.py `_insert_impl`) cannot ride a
+scatter-max — the ULL register state is only PARTIALLY ordered, so it
+sorts the batch by flat register address, collapses duplicates with a
+segmented associative scan of the lattice join, and lands the unique
+survivors with a gather-join-scatter. On XLA-CPU that scan is the
+single slowest sketch op in the tree (~87us/member, BENCH_SUITE_r11
+c17, vs ~1us for HLL's scatter-max).
+
+This kernel is the scatter-join the lattice actually wants: ONE pass
+over the batch doing an in-place read-join-write per update against
+the aliased register buffer. No sort, no scan, no dedup — the join is
+associative, commutative, and idempotent, so ANY application order
+(including duplicate (slot, idx) targets hitting the same register
+repeatedly) folds to the identical final register value the
+sort+scan+dedup path computes. Registers are u8 integers, so
+"identical" here is exact equality, not an up-to-rounding claim —
+tests/test_pallas.py fuzzes byte equality against `_insert_impl`.
+
+`input_output_aliases={0: 0}` makes the register buffer update
+in-place (the enclosing ingest executable donates the bank), so the
+kernel's HBM traffic is the touched registers, not a bank copy."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import count_fallback
+# kernels/ is a blessed sketch-math module (sk01_allow): this kernel
+# IS the ULL insert (fused arm) and shares the one lattice-join
+# definition instead of duplicating it
+from ..sketches import ull as _ull
+
+
+def _insert_kernel(regs_ref, slots_ref, idx_ref, vals_ref, out_ref):
+    n = slots_ref.shape[0]
+
+    def body(i, carry):
+        s = slots_ref[i]
+
+        def land(c):
+            col = idx_ref[i]
+            cur = out_ref[s, col].astype(jnp.int32)
+            val = vals_ref[i].astype(jnp.int32)
+            out_ref[s, col] = _ull._join_i32(cur, val).astype(jnp.uint8)
+            return c
+
+        return jax.lax.cond(s >= 0, land, lambda c: c, carry)
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def fused_insert(bank, slots, reg_idx, vals, interpret: bool):
+    """Batched ULL insert through the scatter-join kernel — the fused
+    twin of sketches/ull._insert_impl (same signature minus the
+    trace-time `interpret` arm constant; jit-composable, caller
+    donates the bank).
+
+    Counted fallback branch (vlint PK01): an unavailable pallas (or a
+    degenerate batch shape) degrades to the XLA sort+scan path —
+    loud, counted, value-identical."""
+    K, m = bank.registers.shape
+    n = int(slots.shape[0])
+    if n == 0 or K == 0:
+        count_fallback(f"ull fused_insert: degenerate shape n={n} K={K}")
+        return _ull._insert_impl(bank, slots, reg_idx, vals)
+    try:
+        from jax.experimental import pallas as pl
+    except Exception as e:          # noqa: BLE001 — pallas absent
+        count_fallback(f"ull fused_insert: pallas unavailable ({e})")
+        return _ull._insert_impl(bank, slots, reg_idx, vals)
+
+    regs = pl.pallas_call(
+        _insert_kernel,
+        out_shape=jax.ShapeDtypeStruct((K, m), jnp.uint8),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(bank.registers, slots.astype(jnp.int32),
+      reg_idx.astype(jnp.int32), vals.astype(jnp.uint8))
+    return _ull.ULLBank(registers=regs)

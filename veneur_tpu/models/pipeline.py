@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import sketches
+from .. import kernels, sketches
 from ..ingest.parser import (
     GLOBAL_ONLY, LOCAL_ONLY, MetricKey, UDPMetric)
 from ..metrics import InterMetric, MetricFrame, MetricType
@@ -130,7 +130,7 @@ def _fresh_banks_executable(device, heng, seng, histogram_slots,
 
 
 @functools.lru_cache(maxsize=None)
-def _ingest_executables(device, heng, seng):
+def _ingest_executables(device, heng, seng, set_arm="xla"):
     """Committed-output builds of the four ingest scatter kernels.
 
     The module-level ops (tdigest.add_batch & co) are plain jits: their
@@ -139,16 +139,27 @@ def _ingest_executables(device, heng, seng):
     which would put every ingest batch AND the following flush on the
     slow path. Pinning out_shardings keeps the whole bank lineage
     committed from _fresh_banks onward. Every sketch op routes through
-    the engine objects — the registry boundary (vlint SK01)."""
+    the engine objects — the registry boundary (vlint SK01).
+
+    `set_arm` (ISSUE 15) selects the set-insert build: engines with a
+    fused Pallas insert (ULL's scatter-join) route through it under
+    the fused/interpret arms; everything else keeps the XLA program.
+    The arm is part of this cache's key, so an engine pair serves
+    exactly one arm per process and /debug reports it truthfully."""
     sds = jax.sharding.SingleDeviceSharding(device)
 
     jit = functools.partial(jax.jit, donate_argnums=(0,),
                             out_shardings=sds)
+    if set_arm != "xla" and hasattr(seng, "insert_fused_impl"):
+        set_insert = functools.partial(
+            seng.insert_fused_impl, interpret=(set_arm == "interpret"))
+    else:
+        set_insert = seng.insert_impl
     return {
         "histo": jit(heng.add_batch_impl),
         "counter": jit(scalar.counter_add.__wrapped__),
         "gauge": jit(scalar.gauge_set.__wrapped__),
-        "set": jit(seng.insert_impl),
+        "set": jit(set_insert),
         # hot-slot sidestep programs (see _add_histo_batch)
         "compress": jit(heng.compress_impl),
         "merge_centroids": jit(heng.merge_centroids_impl),
@@ -157,13 +168,22 @@ def _ingest_executables(device, heng, seng):
 
 
 def _flush_program_body(heng, seng, fwd_out, agg_emit, pallas_ok,
-                        compact):
+                        compact, kernel_arm="xla"):
     """The flush computation itself — compress + quantiles + the
     configured aggregates + counter/gauge/set finalization — as a
     jit-composable closure over (hb, cb, gb, sb, qs). Shared by the
     full-bank executable (_flush_executable) and the incremental
     dirty-slot executable (_inc_flush_executable), so both paths run
     the IDENTICAL math and differ only in which rows they see.
+
+    `kernel_arm` (ISSUE 15, "fused"/"interpret"/"xla") selects the
+    compress build for engines with a fused Pallas kernel: the whole
+    sort + rank-merge + cluster pipeline collapses into ONE pallas_call
+    embedded in this program (VMEM-resident intermediates — no HBM
+    round-trips between the stages), bit-identical to compress_impl by
+    the tests/test_pallas.py contract. Engines without a fused kernel
+    (REQ) ignore the arm. The arm keys every cached executable build,
+    so /debug's per-engine arm stamp can never lie about what compiled.
 
     Output contract (all f32 unless noted):
       q        [K, P']      quantile matrix (P' includes a median column
@@ -194,7 +214,11 @@ def _flush_program_body(heng, seng, fwd_out, agg_emit, pallas_ok,
                             device memory, not wire
     """
     def program(hb, cb, gb, sb, qs):
-        hb = heng.compress_impl(hb)
+        if kernel_arm != "xla" and hasattr(heng, "compress_fused_impl"):
+            hb = heng.compress_fused_impl(
+                hb, interpret=(kernel_arm == "interpret"))
+        else:
+            hb = heng.compress_impl(hb)
         agg = heng.aggregates_impl(hb)
         q = heng.quantile_impl(hb, qs)
         out = {
@@ -261,7 +285,7 @@ def _flush_program_body(heng, seng, fwd_out, agg_emit, pallas_ok,
 
 @functools.lru_cache(maxsize=None)
 def _flush_executable(device, heng, seng, fwd_out, agg_emit, pallas_ok,
-                      donate=True, compact=False):
+                      donate=True, compact=False, kernel_arm="xla"):
     """The fused interval-flush program over the FULL banks: ONE XLA
     call over every slot (see _flush_program_body for the output
     contract). The incremental dirty-slot path (_inc_flush_executable)
@@ -270,7 +294,7 @@ def _flush_executable(device, heng, seng, fwd_out, agg_emit, pallas_ok,
     path above the dirty-fraction threshold."""
     sds = jax.sharding.SingleDeviceSharding(device)
     program = _flush_program_body(heng, seng, fwd_out, agg_emit,
-                                  pallas_ok, compact)
+                                  pallas_ok, compact, kernel_arm)
 
     # donate=False builds a variant safe to dispatch repeatedly on the
     # same banks (bench.py's chained exec estimator); serving always
@@ -346,7 +370,7 @@ def pad_dirty_ids(ids, num_slots: int):
 
 @functools.lru_cache(maxsize=None)
 def _inc_flush_executable(device, heng, seng, fwd_out, agg_emit,
-                          pallas_ok, compact=False):
+                          pallas_ok, compact=False, kernel_arm="xla"):
     """The INCREMENTAL interval-flush program (ISSUE 11 tentpole):
     gather only the dirty piles into a compact [D, ·] work set, run the
     SAME flush body (_flush_program_body) over that slice, and return
@@ -370,7 +394,7 @@ def _inc_flush_executable(device, heng, seng, fwd_out, agg_emit,
     ISSUE 3 audit pins at zero."""
     sds = jax.sharding.SingleDeviceSharding(device)
     program = _flush_program_body(heng, seng, fwd_out, agg_emit,
-                                  pallas_ok, compact)
+                                  pallas_ok, compact, kernel_arm)
 
     def gather(bank, idx):
         return jax.tree_util.tree_map(lambda leaf: leaf[idx], bank)
@@ -384,14 +408,17 @@ def _inc_flush_executable(device, heng, seng, fwd_out, agg_emit,
 
 @functools.lru_cache(maxsize=None)
 def _flush_baseline_cached(device, heng, seng, fwd_out, agg_emit,
-                           pallas_ok, compact, qs):
+                           pallas_ok, compact, qs, kernel_arm="xla"):
     """Empty-flush baseline rows (see _flush_baseline_rows), cached at
     module level so every engine with the same sketch pair + flush
     config shares one K=1 compile. Treat the returned rows as
-    immutable."""
+    immutable. `kernel_arm` rides the key so the baseline is built by
+    the same program arm that serves (bit-identical either way — the
+    fresh row is a compress fixed point under both — but the arm
+    accounting at /debug stays truthful)."""
     from ..ops import scalar as _scalar
     body = _flush_program_body(heng, seng, fwd_out, agg_emit,
-                               pallas_ok, compact)
+                               pallas_ok, compact, kernel_arm)
     fresh = jax.device_put(
         (heng.init(1), _scalar.init_counters(1),
          _scalar.init_gauges(1), seng.init(1)), device)
@@ -584,6 +611,15 @@ class EngineConfig:
     # instead (a near-full gather costs more than it saves).
     flush_incremental: bool = True
     flush_incremental_threshold: float = 0.75
+    # Fused Pallas kernels (ISSUE 15): "auto" compiles the fused
+    # compress / ULL scatter-join on real TPU backends (counted, loud
+    # fallback to the XLA programs when Mosaic refuses) and keeps XLA
+    # on CPU; "on" additionally serves the interpret-mode kernel on
+    # CPU (the testing stance — the oracle/chaos suites run the actual
+    # kernel math end to end, bit-identical by contract); "off" pins
+    # the XLA programs everywhere. /debug/flush's sketch_engines block
+    # reports the arm each engine's executables were built with.
+    fused_kernels: str = "auto"
     # Double-buffered flush (ISSUE 11): the tick boundary only RETIRES
     # the interval under the ingest lock (stage buffers, staged
     # imports, banks, dirty bitmaps swap against fresh shadows in one
@@ -696,6 +732,23 @@ class AggregationEngine:
         over a Mesh instead of single-device ones."""
         cfg = self.cfg
         self._device = jax.devices()[0]
+        # Fused-kernel arm resolution (ISSUE 15): ONE resolved arm per
+        # engine construction, split per sketch engine by capability —
+        # an engine without a fused kernel (REQ/HLL insert) stays on
+        # "xla" no matter the knob, so the /debug arm stamps name what
+        # each engine's executables were ACTUALLY built with.
+        arm = kernels.resolve_arm(cfg.fused_kernels,
+                                  self._device.platform)
+        self._kernel_arms = kernels.verify_engine_kernels(
+            self._heng, self._seng,
+            {
+                "histogram": arm if hasattr(self._heng,
+                                            "compress_fused_impl")
+                else "xla",
+                "set": arm if hasattr(self._seng, "insert_fused_impl")
+                else "xla",
+            },
+            set_slots=cfg.set_slots, batch_size=cfg.batch_size)
         self._fresh_fn = _fresh_banks_executable(
             self._device, self._heng, self._seng, cfg.histogram_slots,
             cfg.counter_slots, cfg.gauge_slots, cfg.set_slots)
@@ -704,7 +757,8 @@ class AggregationEngine:
         (self.histo_bank, self.counter_bank,
          self.gauge_bank, self.set_bank) = self._fresh_fn()
         self._kern = _ingest_executables(self._device, self._heng,
-                                         self._seng)
+                                         self._seng,
+                                         self._kernel_arms["set"])
 
     def _setup_flush_exec(self):
         cfg = self.cfg
@@ -712,7 +766,8 @@ class AggregationEngine:
             self._device, self._heng, self._seng, self._fwd_out,
             tuple(self._agg_emit),
             self._device.platform in ("tpu", "axon"),
-            compact=cfg.flush_fetch_f16)
+            compact=cfg.flush_fetch_f16,
+            kernel_arm=self._kernel_arms["histogram"])
         self._stage_exec = None
         mode = cfg.flush_fetch
         if mode in ("staged", "host"):
@@ -747,6 +802,10 @@ class AggregationEngine:
                 "flush_incremental_threshold must be in (0, 1]: it is "
                 "the dirty fraction above which the full flush program "
                 f"runs, got {self.cfg.flush_incremental_threshold!r}")
+        if self.cfg.fused_kernels not in kernels.MODES:
+            raise ValueError(
+                f"fused_kernels={self.cfg.fused_kernels!r}: must be "
+                f"{'/'.join(kernels.MODES)}")
         # One ingest thread owns process(); flush() may run from another
         # thread. The lock is the Worker.Flush mutex-swap equivalent:
         # ingest holds it per item; flush holds it ONLY across
@@ -1707,7 +1766,8 @@ class AggregationEngine:
                 tuple(self._agg_emit),
                 self._device.platform in ("tpu", "axon"),
                 self.cfg.flush_fetch_f16,
-                tuple(float(q) for q in self._qs))
+                tuple(float(q) for q in self._qs),
+                kernel_arm=self._kernel_arms["histogram"])
         return self._flush_baseline
 
     def _flush_device_incremental(self, snap, phases, dirty):
@@ -1748,7 +1808,8 @@ class AggregationEngine:
             self._device, self._heng, self._seng, self._fwd_out,
             tuple(self._agg_emit),
             self._device.platform in ("tpu", "axon"),
-            compact=self.cfg.flush_fetch_f16)
+            compact=self.cfg.flush_fetch_f16,
+            kernel_arm=self._kernel_arms["histogram"])
         t1 = time.monotonic_ns()
         if phases is not None:
             phases.append(("gather", t0, t1))
@@ -2240,8 +2301,22 @@ class AggregationEngine:
         return sketches.engine_stamp(self._heng, self._seng)
 
     def engines_describe(self) -> dict:
-        """JSON-ready sketch-engine description (/debug/flush)."""
-        return sketches.describe(self._heng, self._seng)
+        """JSON-ready sketch-engine description (/debug/flush),
+        including which kernel arm (fused/xla/interpret) each engine's
+        executables were built with (ISSUE 15 satellite) — bench rows
+        and operator triage read the arm here instead of guessing from
+        the platform, and the process-wide fallback count sits next to
+        it so a probe-refused backend is visible."""
+        d = sketches.describe(self._heng, self._seng)
+        arms = getattr(self, "_kernel_arms", None) \
+            or {"histogram": "xla", "set": "xla"}
+        d["kernels"] = {
+            "requested": getattr(self.cfg, "fused_kernels", "auto"),
+            "histogram_arm": arms["histogram"],
+            "set_arm": arms["set"],
+            "fallback_total": kernels.fallback_total(),
+        }
+        return d
 
     def bank_leaf_names(self, kind: int) -> tuple:
         """The durability leaf order for one bank kind — engine-aware

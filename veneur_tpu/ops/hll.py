@@ -133,7 +133,7 @@ def estimate(bank: HLLBank, force_jnp: bool = False) -> jax.Array:
 
 @jax.jit
 def _estimate_pallas(bank: HLLBank) -> jax.Array:
-    from .pallas_hll import hll_stats
+    from ..kernels.hll_stats import hll_stats
     ez, zsum = hll_stats(bank.registers)
     return _estimate_from_stats(bank, ez, zsum)
 

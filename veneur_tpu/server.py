@@ -90,6 +90,7 @@ class Server:
             flush_incremental_threshold=
             cfg.tpu_flush_incremental_threshold,
             flush_double_buffer=cfg.tpu_flush_double_buffer,
+            fused_kernels=cfg.tpu_fused_kernels,
             forward_enabled=bool(cfg.forward_address
                                  or cfg.consul_forward_service_name),
             # a server with a gRPC import listener is (also) a global tier

@@ -276,6 +276,19 @@ class ULLEngine:
     def insert_impl(self, bank, slots, reg_idx, vals):
         return _insert_impl(bank, slots, reg_idx, vals)
 
+    def insert_fused_impl(self, bank, slots, reg_idx, vals,
+                          interpret: bool):
+        """The Pallas scatter-join insert arm (ISSUE 15): one in-place
+        read-join-write pass over the batch, replacing the XLA
+        sort + segmented-scan + gather path — register-byte-identical
+        (the join is associative/commutative/idempotent, so any
+        application order folds to the same lattice value; pinned by
+        tests/test_pallas.py). The ingest executable selects this when
+        the resolved kernel arm is fused/interpret."""
+        from ..kernels import ull_insert as kinsert
+        return kinsert.fused_insert(bank, slots, reg_idx, vals,
+                                    interpret)
+
     def merge_rows_impl(self, bank, slots, registers):
         return _merge_rows_impl(bank, slots, registers)
 
